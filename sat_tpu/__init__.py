@@ -1,0 +1,15 @@
+"""sat_tpu — a TPU-native Show, Attend and Tell framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+Cheng-Lin-Li/show-attend-and-tell (TF1): VGG16/ResNet50 encoders, the
+soft-attention LSTM decoder, masked-CE + doubly-stochastic-attention
+training, on-device batched beam search, COCO data/vocabulary pipeline,
+BLEU/METEOR/ROUGE-L/CIDEr evaluation, npy-compatible checkpointing, and
+SPMD data/context-parallel training over a jax.sharding.Mesh.
+"""
+
+from .config import Config
+
+__version__ = "0.1.0"
+
+__all__ = ["Config"]

@@ -1,0 +1,152 @@
+"""Configuration for the sat_tpu framework.
+
+Capability parity with the reference config object
+(/root/reference/config.py:4-85): one flat namespace holding every
+architecture / optimization / path knob, CLI-overridable, and persisted as
+part of every checkpoint (the reference pickles its config next to each
+.npy checkpoint, /root/reference/base_model.py:250-253).
+
+TPU-first additions live in their own section at the bottom: dtype policy,
+mesh shape, prefetch depth, on-device decode knobs.  Defaults reproduce the
+reference's published-run configuration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclass
+class Config:
+    # ---- architecture (reference config.py:8-17) ----
+    cnn: str = "vgg16"                 # 'vgg16' or 'resnet50'
+    max_caption_length: int = 20
+    dim_embedding: int = 512
+    num_lstm_units: int = 512
+    num_initialize_layers: int = 2     # 1 or 2
+    dim_initialize_layer: int = 512
+    num_attend_layers: int = 2         # 1 or 2
+    dim_attend_layer: int = 512
+    num_decode_layers: int = 2         # 1 or 2
+    dim_decode_layer: int = 1024
+
+    # ---- init / regularization (reference config.py:20-27) ----
+    fc_kernel_initializer_scale: float = 0.08
+    fc_kernel_regularizer_scale: float = 1e-4
+    fc_activity_regularizer_scale: float = 0.0
+    conv_kernel_regularizer_scale: float = 1e-4
+    conv_activity_regularizer_scale: float = 0.0
+    fc_drop_rate: float = 0.5
+    lstm_drop_rate: float = 0.3
+    attention_loss_factor: float = 0.01
+
+    # ---- optimization (reference config.py:30-43) ----
+    num_epochs: int = 30
+    batch_size: int = 20
+    optimizer: str = "Adam"            # 'Adam', 'RMSProp', 'Momentum', 'SGD'
+    initial_learning_rate: float = 1e-4
+    learning_rate_decay_factor: float = 1.0
+    num_steps_per_decay: int = 100000
+    clip_gradients: float = 5.0
+    momentum: float = 0.0
+    use_nesterov: bool = True
+    decay: float = 0.9
+    centered: bool = True
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-6
+
+    # ---- phase / runtime ----
+    phase: str = "train"               # 'train', 'eval' or 'test'
+    train_cnn: bool = False
+    beam_size: int = 3
+
+    # ---- saver (reference config.py:53-55) ----
+    save_period: int = 50
+    save_dir: str = "./data/models/"
+    summary_dir: str = "./summary/"
+
+    # ---- dataset-size caps (reference config.py:60-63) ----
+    max_train_ann_num: Optional[int] = 1000
+    max_eval_ann_num: Optional[int] = 20
+
+    # ---- vocabulary (reference config.py:66-67) ----
+    vocabulary_file: str = "./data/vocabulary.csv"
+    vocabulary_size: int = 5000
+
+    # ---- training data paths (reference config.py:70-73) ----
+    train_image_dir: str = "./data/train/images/"
+    train_caption_file: str = "./data/train/captions_train2014.json"
+    temp_annotation_file: str = "./data/train/anns.csv"
+    temp_data_file: str = "./data/train/data.npy"
+
+    # ---- evaluation paths (reference config.py:76-80) ----
+    eval_image_dir: str = "./data/val/images/"
+    eval_caption_file: str = "./data/val/captions_val2014.json"
+    eval_result_dir: str = "./data/val/results/"
+    eval_result_file: str = "./data/val/results.json"
+    save_eval_result_as_image: bool = False
+
+    # ---- testing paths (reference config.py:83-85) ----
+    test_image_dir: str = "./data/test/images/"
+    test_result_dir: str = "./data/test/results/"
+    test_result_file: str = "./data/test/results.csv"
+
+    # ---- TPU-native knobs (no reference equivalent) ----
+    compute_dtype: str = "bfloat16"    # MXU-friendly matmul/conv dtype
+    param_dtype: str = "float32"       # master params stay fp32
+    mesh_shape: Tuple[int, ...] = (1, 1)   # (data, model) device mesh
+    mesh_axes: Tuple[str, ...] = ("data", "model")
+    context_parallel: int = 1          # shard the context grid over 'model'
+    prefetch_depth: int = 2            # host→HBM async pipeline depth
+    use_pallas_attention: bool = False # fused pallas soft-attention kernel
+    decode_on_device: bool = True      # lax.scan beam search vs host loop
+    num_data_workers: int = 8          # image-decode thread pool
+    log_every: int = 10                # metric-writer cadence (steps)
+    global_step: int = 0               # persisted into checkpoints
+
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    # -- persistence: configs ride along with checkpoints, like the
+    #    reference's config.pickle (base_model.py:250-253) but as JSON. --
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, default=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        with open(path) as f:
+            raw = json.load(f)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any]) -> "Config":
+        names = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in raw.items() if k in names}
+        for key in ("mesh_shape", "mesh_axes"):
+            if key in kw and isinstance(kw[key], list):
+                kw[key] = tuple(kw[key])
+        return cls(**kw)
+
+    @property
+    def is_train(self) -> bool:
+        return self.phase == "train"
+
+    @property
+    def num_ctx(self) -> int:
+        """Spatial context-grid size (reference model.py:58,107)."""
+        return 196 if self.cnn == "vgg16" else 49
+
+    @property
+    def dim_ctx(self) -> int:
+        """Context feature dim (reference model.py:59,108)."""
+        return 512 if self.cnn == "vgg16" else 2048

@@ -1,0 +1,28 @@
+from .coco import CocoCaptions
+from .dataset import (
+    DataSet,
+    build_vocabulary,
+    prepare_eval_data,
+    prepare_test_data,
+    prepare_train_data,
+)
+from .images import ILSVRC_2012_MEAN, ImageLoader, PrefetchLoader
+from .tokenizer import PUNCTUATIONS, tokenize, tokenize_captions, tokenize_no_punct
+from .vocabulary import Vocabulary
+
+__all__ = [
+    "CocoCaptions",
+    "DataSet",
+    "Vocabulary",
+    "ImageLoader",
+    "PrefetchLoader",
+    "ILSVRC_2012_MEAN",
+    "PUNCTUATIONS",
+    "tokenize",
+    "tokenize_captions",
+    "tokenize_no_punct",
+    "prepare_train_data",
+    "prepare_eval_data",
+    "prepare_test_data",
+    "build_vocabulary",
+]

@@ -1,0 +1,174 @@
+"""COCO captions index.
+
+A from-scratch, dependency-light equivalent of the reference's vendored and
+modified COCO toolkit (/root/reference/utils/coco/coco.py:68-364), keeping
+its behavioral contract:
+
+* optional ``max_ann_num`` cap applied to the first N annotations
+  (coco.py:119-124);
+* caption normalization at load: lowercase + ensure trailing ``'.'``
+  (``process_dataset``, coco.py:316-321);
+* ``filter_by_cap_len`` keeps annotations whose caption tokenizes to at
+  most N tokens (coco.py:323-339);
+* ``filter_by_words`` keeps annotations fully covered by a vocabulary
+  (coco.py:341-361) — unlike the reference we also drop images left with
+  no annotations (the reference keeps them due to a counting bug at
+  coco.py:352);
+* ``load_results`` validates a predictions JSON against the ground-truth
+  image set and wraps it in a new index (``loadRes``, coco.py:263-290);
+* ``download`` fetches any missing images by ``coco_url`` (coco.py:292-314).
+
+Tokenization uses our native Treebank tokenizer instead of nltk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from .tokenizer import tokenize
+
+
+class CocoCaptions:
+    def __init__(
+        self,
+        annotation_file: Optional[str] = None,
+        max_ann_num: Optional[int] = None,
+    ):
+        self.dataset: Dict = {"images": [], "annotations": []}
+        self.anns: Dict[int, Dict] = {}
+        self.imgs: Dict[int, Dict] = {}
+        self.img_to_anns: Dict[int, List[Dict]] = {}
+        self.img_name_to_id: Dict[str, int] = {}
+        self.max_ann_num = max_ann_num
+
+        if annotation_file is not None:
+            with open(annotation_file) as f:
+                self.dataset = json.load(f)
+            self._normalize_captions()
+            self.create_index(max_ann_num)
+
+    # -- aliases so call sites written against the reference API work --
+    @property
+    def imgToAnns(self) -> Dict[int, List[Dict]]:  # noqa: N802
+        return self.img_to_anns
+
+    def _normalize_captions(self) -> None:
+        for ann in self.dataset.get("annotations", []):
+            q = ann["caption"].lower()
+            if not q.endswith("."):
+                q = q + "."
+            ann["caption"] = q
+
+    def create_index(self, max_ann_num: Optional[int] = None) -> None:
+        anns: Dict[int, Dict] = {}
+        img_to_anns: Dict[int, List[Dict]] = {}
+        annotations = self.dataset.get("annotations", [])
+        if max_ann_num is not None:
+            annotations = annotations[:max_ann_num]
+        for ann in annotations:
+            anns[ann["id"]] = ann
+            img_to_anns.setdefault(ann["image_id"], []).append(ann)
+
+        imgs: Dict[int, Dict] = {}
+        img_name_to_id: Dict[str, int] = {}
+        for img in self.dataset.get("images", []):
+            imgs[img["id"]] = img
+            if "file_name" in img:
+                img_name_to_id[img["file_name"]] = img["id"]
+
+        self.anns = anns
+        self.img_to_anns = img_to_anns
+        self.imgs = imgs
+        self.img_name_to_id = img_name_to_id
+
+    # ---- filters (rebuild the index afterwards, like the reference) ----
+
+    def filter_by_cap_len(self, max_cap_len: int) -> None:
+        keep = [
+            ann
+            for ann in self.dataset["annotations"]
+            if len(tokenize(ann["caption"])) <= max_cap_len
+        ]
+        self._apply_ann_filter(keep)
+
+    def filter_by_words(self, vocab: Set[str]) -> None:
+        keep = [
+            ann
+            for ann in self.dataset["annotations"]
+            if all(w in vocab for w in tokenize(ann["caption"]))
+        ]
+        self._apply_ann_filter(keep)
+
+    def _apply_ann_filter(self, kept_anns: List[Dict]) -> None:
+        kept_img_ids = {ann["image_id"] for ann in kept_anns}
+        self.dataset["annotations"] = kept_anns
+        self.dataset["images"] = [
+            img for img in self.dataset["images"] if img["id"] in kept_img_ids
+        ]
+        self.create_index()
+
+    # ---- accessors ----
+
+    def all_captions(self) -> List[str]:
+        return [ann["caption"] for ann in self.anns.values()]
+
+    def get_img_ids(self) -> List[int]:
+        return list(self.imgs.keys())
+
+    # ---- results wrapping for evaluation ----
+
+    def load_results(self, res_file_or_list) -> "CocoCaptions":
+        """Build a result index from a predictions JSON file or list of
+        ``{'image_id': int, 'caption': str}`` dicts."""
+        if isinstance(res_file_or_list, str):
+            with open(res_file_or_list) as f:
+                anns = json.load(f)
+        else:
+            # copy so assigning result ids never mutates the caller's dicts
+            anns = [dict(a) for a in res_file_or_list]
+        assert isinstance(anns, list), "results must be a list of objects"
+        assert anns and "caption" in anns[0], "results must contain captions"
+        res_img_ids = {ann["image_id"] for ann in anns}
+        missing = res_img_ids - set(self.imgs.keys())
+        assert not missing, f"results reference unknown image ids: {sorted(missing)[:5]}"
+
+        res = CocoCaptions()
+        res.dataset["images"] = [
+            img for img in self.dataset["images"] if img["id"] in res_img_ids
+        ]
+        for i, ann in enumerate(anns):
+            ann["id"] = i + 1
+        res.dataset["annotations"] = anns
+        res.create_index()
+        return res
+
+    loadRes = load_results  # reference-API alias  # noqa: N815
+
+    # ---- image download (idempotent, like reference coco.py:292-314) ----
+
+    def download(self, target_dir: str, img_ids: Sequence[int] = ()) -> int:
+        from urllib.request import urlretrieve
+
+        imgs = (
+            [self.imgs[i] for i in img_ids] if len(img_ids) else list(self.imgs.values())
+        )
+        os.makedirs(target_dir, exist_ok=True)
+        fetched = 0
+        failed = 0
+        for img in imgs:
+            fname = os.path.join(target_dir, img["file_name"])
+            if not os.path.exists(fname):
+                if "coco_url" not in img:
+                    continue
+                try:
+                    urlretrieve(img["coco_url"], fname)
+                    fetched += 1
+                except OSError:
+                    # keep going: a missing image surfaces later with a
+                    # clear FileNotFoundError naming the file
+                    failed += 1
+        if failed:
+            print(f"warning: failed to download {failed} missing images")
+        return fetched

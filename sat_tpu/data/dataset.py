@@ -1,0 +1,210 @@
+"""Batch iteration and data preparation.
+
+Parity targets: the reference ``DataSet`` (/root/reference/dataset.py:11-72)
+— fixed batch size with the last batch padded by randomly resampled items
+and ``fake_count`` recording the padding (dataset.py:29-35,51-54), shuffle
+on reset for training — and the ``prepare_{train,eval,test}_data`` entry
+points (dataset.py:74-239) including the anns.csv / data.npy preprocessing
+caches and vocabulary build-or-load logic.
+
+The fixed batch size is deliberate: static shapes keep every XLA program
+compiled exactly once.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from .coco import CocoCaptions
+from .vocabulary import Vocabulary
+
+
+class DataSet:
+    def __init__(
+        self,
+        image_ids,
+        image_files,
+        batch_size: int,
+        word_idxs=None,
+        masks=None,
+        is_train: bool = False,
+        shuffle: bool = False,
+        seed: Optional[int] = None,
+    ):
+        self.image_ids = np.array(image_ids)
+        self.image_files = np.array(image_files)
+        self.word_idxs = None if word_idxs is None else np.array(word_idxs)
+        self.masks = None if masks is None else np.array(masks)
+        self.batch_size = batch_size
+        self.is_train = is_train
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self.setup()
+
+    def setup(self) -> None:
+        self.count = len(self.image_ids)
+        self.num_batches = int(np.ceil(self.count / self.batch_size))
+        self.fake_count = self.num_batches * self.batch_size - self.count
+        self.idxs = list(range(self.count))
+        self.reset()
+
+    def reset(self) -> None:
+        self.current_idx = 0
+        if self.shuffle:
+            self._rng.shuffle(self.idxs)
+
+    def has_next_batch(self) -> bool:
+        return self.current_idx < self.count
+
+    def has_full_next_batch(self) -> bool:
+        return self.current_idx + self.batch_size <= self.count
+
+    def next_batch(self):
+        """Returns (files, word_idxs, masks) when training, else files.
+        The final partial batch is padded to full size with resampled items
+        (reference dataset.py:51-54) so device shapes never change."""
+        assert self.has_next_batch()
+        if self.has_full_next_batch():
+            current_idxs = self.idxs[self.current_idx : self.current_idx + self.batch_size]
+        else:
+            current_idxs = self.idxs[self.current_idx : self.count] + list(
+                self._rng.choice(self.count, self.fake_count)
+            )
+        self.current_idx += self.batch_size
+        image_files = self.image_files[current_idxs]
+        if self.is_train:
+            return image_files, self.word_idxs[current_idxs], self.masks[current_idxs]
+        return image_files
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next_batch():
+            yield self.next_batch()
+
+
+def prepare_train_data(config: Config) -> DataSet:
+    """COCO load → length filter → vocab build-or-load → word filter →
+    tokenize+cache → DataSet (reference dataset.py:74-169)."""
+    coco = CocoCaptions(config.train_caption_file, config.max_train_ann_num)
+    coco.filter_by_cap_len(config.max_caption_length)
+
+    vocabulary = Vocabulary(config.vocabulary_size)
+    if not os.path.exists(config.vocabulary_file):
+        captions = coco.all_captions()
+        if config.max_train_ann_num:
+            captions = captions[: config.max_train_ann_num]
+        vocabulary.build(captions)
+        vocabulary.save(config.vocabulary_file)
+    else:
+        vocabulary.load(config.vocabulary_file)
+
+    coco.filter_by_words(set(vocabulary.words))
+
+    if not os.path.exists(config.temp_annotation_file):
+        ann_ids = list(coco.anns.keys())
+        if config.max_train_ann_num:
+            ann_ids = ann_ids[: config.max_train_ann_num]
+        captions = [coco.anns[i]["caption"] for i in ann_ids]
+        image_ids = [coco.anns[i]["image_id"] for i in ann_ids]
+        image_files = [
+            os.path.join(config.train_image_dir, coco.imgs[i]["file_name"])
+            for i in image_ids
+        ]
+        import pandas as pd
+
+        os.makedirs(os.path.dirname(config.temp_annotation_file) or ".", exist_ok=True)
+        pd.DataFrame(
+            {"image_id": image_ids, "image_file": image_files, "caption": captions}
+        ).to_csv(config.temp_annotation_file)
+    else:
+        import pandas as pd
+
+        annotations = pd.read_csv(config.temp_annotation_file)
+        n = config.max_train_ann_num or len(annotations)
+        captions = list(annotations["caption"].values[:n])
+        image_ids = list(annotations["image_id"].values[:n])
+        image_files = list(annotations["image_file"].values[:n])
+
+    if not os.path.exists(config.temp_data_file):
+        word_idxs = np.zeros((len(captions), config.max_caption_length), np.int32)
+        masks = np.zeros((len(captions), config.max_caption_length), np.float32)
+        for i, caption in enumerate(captions):
+            idxs = vocabulary.process_sentence(caption)
+            n_words = min(len(idxs), config.max_caption_length)
+            word_idxs[i, :n_words] = idxs[:n_words]
+            masks[i, :n_words] = 1.0
+        os.makedirs(os.path.dirname(config.temp_data_file) or ".", exist_ok=True)
+        np.save(config.temp_data_file, {"word_idxs": word_idxs, "masks": masks})
+    else:
+        data = np.load(config.temp_data_file, allow_pickle=True).item()
+        word_idxs, masks = data["word_idxs"], data["masks"]
+
+    # self-heal a partially populated image dir (reference dataset.py:156-158)
+    coco.download(config.train_image_dir, image_ids)
+
+    return DataSet(
+        image_ids,
+        image_files,
+        config.batch_size,
+        word_idxs,
+        masks,
+        is_train=True,
+        shuffle=True,
+    )
+
+
+def prepare_eval_data(config: Config) -> Tuple[CocoCaptions, DataSet, Vocabulary]:
+    """(ground-truth COCO, unshuffled DataSet, Vocabulary)
+    (reference dataset.py:171-205)."""
+    coco = CocoCaptions(config.eval_caption_file, config.max_eval_ann_num)
+    if not config.max_eval_ann_num:
+        image_ids = list(coco.imgs.keys())
+    else:
+        ann_ids = list(coco.anns.keys())[: config.max_eval_ann_num]
+        image_ids = [coco.anns[i]["image_id"] for i in ann_ids]
+    image_files = [
+        os.path.join(config.eval_image_dir, coco.imgs[i]["file_name"])
+        for i in image_ids
+    ]
+
+    vocabulary = _load_or_build_vocabulary(config)
+    # self-heal missing eval images (reference dataset.py:198-200)
+    coco.download(config.eval_image_dir, image_ids)
+    dataset = DataSet(image_ids, image_files, config.batch_size)
+    return coco, dataset, vocabulary
+
+
+def prepare_test_data(config: Config) -> Tuple[DataSet, Vocabulary]:
+    """Caption arbitrary JPEGs from a directory (reference dataset.py:207-226)."""
+    files = sorted(
+        f
+        for f in glob.glob(os.path.join(config.test_image_dir, "*"))
+        if f.lower().endswith((".jpg", ".jpeg"))
+    )
+    image_ids = list(range(len(files)))
+    vocabulary = _load_or_build_vocabulary(config)
+    return DataSet(image_ids, files, config.batch_size), vocabulary
+
+
+def _load_or_build_vocabulary(config: Config) -> Vocabulary:
+    if os.path.exists(config.vocabulary_file):
+        return Vocabulary(config.vocabulary_size, config.vocabulary_file)
+    return build_vocabulary(config)
+
+
+def build_vocabulary(config: Config) -> Vocabulary:
+    """Build from training captions and save (reference dataset.py:228-239)."""
+    coco = CocoCaptions(config.train_caption_file, config.max_train_ann_num)
+    coco.filter_by_cap_len(config.max_caption_length)
+    vocabulary = Vocabulary(config.vocabulary_size)
+    captions = coco.all_captions()
+    if config.max_train_ann_num:
+        captions = captions[: config.max_train_ann_num]
+    vocabulary.build(captions)
+    vocabulary.save(config.vocabulary_file)
+    return vocabulary

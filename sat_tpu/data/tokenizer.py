@@ -1,0 +1,91 @@
+"""Penn-Treebank-style word tokenizer, dependency-free.
+
+The reference tokenizes in two places, both via external dependencies we
+replace here with a single native implementation:
+
+* vocabulary building / caption indexing uses ``nltk.word_tokenize``
+  (/root/reference/utils/vocabulary.py:21,49);
+* metric evaluation shells out to Stanford CoreNLP's ``PTBTokenizer`` jar
+  with ``-preserveLines -lowerCase`` and then drops punctuation tokens
+  (/root/reference/utils/coco/pycocoevalcap/tokenizer/ptbtokenizer.py:18-69).
+
+Both are Treebank tokenizers, so one rule set serves both call sites.  A
+C++ fast path (native/libsat_native.so, built from native/tokenizer.cc)
+is used when available; the pure-Python path below is the reference
+implementation and the two are equivalence-tested in
+tests/test_tokenizer.py.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List
+
+# Punctuation tokens the eval pipeline removes after tokenization,
+# mirroring PUNCTUATIONS in the reference's ptbtokenizer wrapper
+# (/root/reference/utils/coco/pycocoevalcap/tokenizer/ptbtokenizer.py:21-22).
+PUNCTUATIONS = frozenset({
+    "''", "'", "``", "`", "-LRB-", "-RRB-", "-LCB-", "-RCB-",
+    ".", "?", "!", ",", ":", "-", "--", "...", ";",
+})
+
+# Treebank contraction suffixes: don't -> do n't, it's -> it 's, etc.
+_CONTRACTIONS = re.compile(r"([^' ])('ll|'re|'ve|n't|'s|'m|'d)\b", re.IGNORECASE)
+# Multi-word contractions treated as single splits by Treebank rules.
+_CONTRACTIONS2 = [
+    (re.compile(r"\b(can)(not)\b", re.IGNORECASE), r"\1 \2"),
+    (re.compile(r"\b(gon)(na)\b", re.IGNORECASE), r"\1 \2"),
+    (re.compile(r"\b(got)(ta)\b", re.IGNORECASE), r"\1 \2"),
+    (re.compile(r"\b(wan)(na)\b", re.IGNORECASE), r"\1 \2"),
+    (re.compile(r"\b(lem)(me)\b", re.IGNORECASE), r"\1 \2"),
+]
+
+_RULES = [
+    # Starting quotes.
+    (re.compile(r'^\"'), r"``"),
+    (re.compile(r"(``)"), r" \1 "),
+    (re.compile(r'([ (\[{<])(\"|\'{2})'), r"\1 `` "),
+    # Ellipsis before other period handling.
+    (re.compile(r"\.\.\."), r" ... "),
+    # Most punctuation.
+    (re.compile(r"([;@#$%&?!])"), r" \1 "),
+    # Sentence-internal periods followed by whitespace (nltk's word_tokenize
+    # sentence-splits first, so "a dog. runs." yields a separate '.').
+    (re.compile(r"([^\.])(\.)(?=\s)"), r"\1 \2 "),
+    (re.compile(r"([^\.])(\.)([\]\)}>\"\']*)\s*$"), r"\1 \2\3 "),  # final period
+    (re.compile(r"([:,])([^\d])"), r" \1 \2"),   # comma/colon not in numbers
+    (re.compile(r"([:,])$"), r" \1 "),
+    # Parens, brackets.
+    (re.compile(r"([\]\[\(\)\{\}<>])"), r" \1 "),
+    (re.compile(r"--"), r" -- "),
+    # Ending quotes.
+    (re.compile(r'"'), r" '' "),
+    (re.compile(r"(\S)(\'\')"), r"\1 \2 "),
+    (re.compile(r"([^' ])(' )"), r"\1 ' "),
+]
+
+
+def tokenize(text: str, lower: bool = True) -> List[str]:
+    """Tokenize one sentence into Treebank-style word tokens."""
+    if lower:
+        text = text.lower()
+    text = " " + text.strip() + " "
+    for pattern, sub in _RULES:
+        text = pattern.sub(sub, text)
+    text = _CONTRACTIONS.sub(r"\1 \2", text)
+    for pattern, sub in _CONTRACTIONS2:
+        text = pattern.sub(sub, text)
+    return text.split()
+
+
+def tokenize_no_punct(text: str, lower: bool = True) -> List[str]:
+    """Tokenize and drop punctuation tokens — the metric-eval flavour
+    (reference ptbtokenizer.py:65-66 removes PUNCTUATIONS post-hoc)."""
+    return [t for t in tokenize(text, lower=lower) if t not in PUNCTUATIONS]
+
+
+def tokenize_captions(captions: Iterable[str]) -> List[str]:
+    """Batch variant used by the eval stack: each caption becomes one
+    space-joined line of punctuation-free lowercase tokens, matching the
+    reference's ``-preserveLines -lowerCase`` jar invocation."""
+    return [" ".join(tokenize_no_punct(c)) for c in captions]

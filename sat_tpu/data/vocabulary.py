@@ -1,0 +1,109 @@
+"""Frequency-sorted caption vocabulary.
+
+Behavioral parity with the reference Vocabulary
+(/root/reference/utils/vocabulary.py): index 0 is ``<start>``, the sentence
+terminator is the literal ``'.'`` token, entries are the top-(size-1) words
+by corpus frequency, frequencies are stored log-normalized, and the on-disk
+format is the same pandas CSV (columns: index, frequency, index, word) so
+the reference's prebuilt ``vocabulary.csv`` loads unchanged.
+
+Differences by design: tokenization uses our native Treebank tokenizer
+(sat_tpu.data.tokenizer) instead of nltk, and ``process_sentence`` can
+optionally skip OOV words instead of raising (the reference raises KeyError
+on OOV, vocabulary.py:50, relying on the corpus being pre-filtered).
+"""
+
+from __future__ import annotations
+
+import os
+import string
+from typing import Dict, Iterable, List, Sequence
+
+import numpy as np
+
+from .tokenizer import tokenize
+
+
+class Vocabulary:
+    def __init__(self, size: int, save_file: str | None = None):
+        self.words: List[str] = []
+        self.word2idx: Dict[str, int] = {}
+        self.word_frequencies: np.ndarray | List[float] = []
+        self.size = size
+        if save_file is not None:
+            self.load(save_file)
+
+    def build(self, sentences: Iterable[str]) -> None:
+        word_counts: Dict[str, float] = {}
+        for sentence in sentences:
+            for w in tokenize(sentence):
+                word_counts[w] = word_counts.get(w, 0) + 1.0
+
+        # Shrink when the corpus has fewer distinct words than requested
+        # (reference vocabulary.py:25-26).
+        if self.size - 1 > len(word_counts):
+            self.size = len(word_counts) + 1
+
+        self.words = ["<start>"]
+        self.word2idx = {"<start>": 0}
+        freqs = [1.0]
+
+        ranked = sorted(word_counts.items(), key=lambda kv: kv[1], reverse=True)
+        for idx in range(self.size - 1):
+            word, frequency = ranked[idx]
+            self.words.append(word)
+            self.word2idx[word] = idx + 1
+            freqs.append(frequency)
+
+        f = np.array(freqs, dtype=np.float64)
+        f /= f.sum()
+        f = np.log(f)
+        f -= f.max()
+        self.word_frequencies = f
+
+    def process_sentence(self, sentence: str, skip_oov: bool = False) -> List[int]:
+        """Tokenize and map to vocab indices (reference vocabulary.py:46-51)."""
+        words = tokenize(sentence)
+        if skip_oov:
+            return [self.word2idx[w] for w in words if w in self.word2idx]
+        return [self.word2idx[w] for w in words]
+
+    def get_sentence(self, idxs: Sequence[int]) -> str:
+        """Indices → detokenized sentence, truncated at the first '.'
+        (reference vocabulary.py:53-63)."""
+        words = [self.words[int(i)] for i in idxs]
+        if not words or words[-1] != ".":
+            words.append(".")
+        length = int(np.argmax(np.array(words) == ".")) + 1
+        words = words[:length]
+        sentence = "".join(
+            " " + w if not w.startswith("'") and w not in string.punctuation else w
+            for w in words
+        ).strip()
+        return sentence
+
+    def save(self, save_file: str) -> None:
+        import pandas as pd
+
+        os.makedirs(os.path.dirname(save_file) or ".", exist_ok=True)
+        pd.DataFrame(
+            {
+                "word": list(self.words),
+                "index": list(range(self.size)),
+                "frequency": list(np.asarray(self.word_frequencies)),
+            }
+        ).to_csv(save_file)
+
+    def load(self, save_file: str) -> None:
+        import pandas as pd
+
+        assert os.path.exists(save_file), save_file
+        data = pd.read_csv(save_file)
+        # Truncate everything to the requested size so words, word2idx and
+        # word_frequencies stay mutually consistent even when the CSV holds
+        # more rows than this vocabulary is configured for.
+        n = min(self.size, len(data))
+        self.words = [str(w) for w in data["word"].values[:n]]
+        self.word2idx = {w: i for i, w in enumerate(self.words)}
+        self.word_frequencies = data["frequency"].values[:n]
+        self.size = n

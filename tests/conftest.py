@@ -1,0 +1,32 @@
+"""Test harness setup.
+
+Forces JAX onto the host CPU backend with 8 virtual devices BEFORE jax is
+imported anywhere, so mesh/sharding tests emulate a multi-chip TPU slice
+without hardware (see SURVEY.md §4's test plan).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def coco_fixture(tmp_path_factory):
+    """A tiny synthetic COCO-captions dataset with real image files."""
+    from tests.fixtures import make_coco_fixture
+
+    root = tmp_path_factory.mktemp("coco")
+    return make_coco_fixture(str(root))
