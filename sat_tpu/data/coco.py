@@ -128,11 +128,16 @@ class CocoCaptions:
         else:
             # copy so assigning result ids never mutates the caller's dicts
             anns = [dict(a) for a in res_file_or_list]
-        assert isinstance(anns, list), "results must be a list of objects"
-        assert anns and "caption" in anns[0], "results must contain captions"
+        if not isinstance(anns, list):
+            raise ValueError("results must be a list of objects")
+        if not anns or "caption" not in anns[0]:
+            raise ValueError("results must contain captions")
         res_img_ids = {ann["image_id"] for ann in anns}
         missing = res_img_ids - set(self.imgs.keys())
-        assert not missing, f"results reference unknown image ids: {sorted(missing)[:5]}"
+        if missing:
+            raise ValueError(
+                f"results reference unknown image ids: {sorted(missing)[:5]}"
+            )
 
         res = CocoCaptions()
         res.dataset["images"] = [

@@ -47,7 +47,7 @@ class ImageLoader:
         return image.astype(np.float32) - self.mean
 
     def load_images(self, image_files: Sequence[str]) -> np.ndarray:
-        return np.stack([self.load_image(f) for f in image_files]).astype(np.float32)
+        return np.stack([self.load_image(f) for f in image_files])
 
 
 class PrefetchLoader:
@@ -78,9 +78,7 @@ class PrefetchLoader:
             }
         else:
             files, out = batch, {}
-        out["images"] = np.stack(list(pool.map(self.loader.load_image, files))).astype(
-            np.float32
-        )
+        out["images"] = np.stack(list(pool.map(self.loader.load_image, files)))
         out["files"] = list(files)
         return out
 

@@ -9,11 +9,7 @@ replace here with a single native implementation:
   with ``-preserveLines -lowerCase`` and then drops punctuation tokens
   (/root/reference/utils/coco/pycocoevalcap/tokenizer/ptbtokenizer.py:18-69).
 
-Both are Treebank tokenizers, so one rule set serves both call sites.  A
-C++ fast path (native/libsat_native.so, built from native/tokenizer.cc)
-is used when available; the pure-Python path below is the reference
-implementation and the two are equivalence-tested in
-tests/test_tokenizer.py.
+Both are Treebank tokenizers, so one rule set serves both call sites.
 """
 
 from __future__ import annotations

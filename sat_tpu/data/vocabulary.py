@@ -98,7 +98,8 @@ class Vocabulary:
         import pandas as pd
 
         assert os.path.exists(save_file), save_file
-        data = pd.read_csv(save_file)
+        # keep_default_na: words like 'null'/'nan' must stay strings
+        data = pd.read_csv(save_file, keep_default_na=False)
         # Truncate everything to the requested size so words, word2idx and
         # word_frequencies stay mutually consistent even when the CSV holds
         # more rows than this vocabulary is configured for.

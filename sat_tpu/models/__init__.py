@@ -1,0 +1,30 @@
+from .captioner import compute_loss, encode, init_variables, make_encoder
+from .decoder import (
+    DecoderState,
+    attend,
+    decode_logits,
+    decoder_step,
+    init_decoder_params,
+    init_state,
+    lstm_step,
+    teacher_forced_decode,
+)
+from .resnet50 import ResNet50
+from .vgg16 import VGG16
+
+__all__ = [
+    "VGG16",
+    "ResNet50",
+    "DecoderState",
+    "attend",
+    "decode_logits",
+    "decoder_step",
+    "init_decoder_params",
+    "init_state",
+    "lstm_step",
+    "teacher_forced_decode",
+    "compute_loss",
+    "encode",
+    "init_variables",
+    "make_encoder",
+]

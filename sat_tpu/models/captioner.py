@@ -1,0 +1,150 @@
+"""The full caption model: CNN encoder + attention-LSTM decoder + losses.
+
+Equivalent of the reference CaptionGenerator (/root/reference/model.py:6-13)
+plus its loss graph (model.py:293-334), reorganized functionally:
+
+* ``init_variables`` builds the parameter pytree {'cnn': ..., 'decoder': ...}
+  (+ 'batch_stats' for ResNet50's BN);
+* ``encode`` maps images → context grid, with stop_gradient when the CNN is
+  frozen (the reference freezes via trainable=False, utils/nn.py:66);
+* ``compute_loss`` reproduces the three-part objective: masked
+  cross-entropy normalized by total mask, the doubly-stochastic attention
+  penalty 0.01 * l2(1-Σα_masked)/(B·N), and L2 weight regularization —
+  plus teacher-forced token accuracy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..nn.layers import regularization_loss
+from .decoder import init_decoder_params, teacher_forced_decode
+from .resnet50 import ResNet50
+from .vgg16 import VGG16
+
+
+def make_encoder(config: Config):
+    dtype = jnp.dtype(config.compute_dtype)
+    if config.cnn == "vgg16":
+        return VGG16(dtype=dtype)
+    return ResNet50(dtype=dtype)
+
+
+def init_variables(rng: jax.Array, config: Config) -> Dict[str, Any]:
+    """Initialize all model variables with dummy image input."""
+    k_cnn, k_dec = jax.random.split(rng)
+    encoder = make_encoder(config)
+    dummy = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    cnn_vars = encoder.init(k_cnn, dummy, train=False)
+    out = {
+        "params": {
+            "cnn": cnn_vars["params"],
+            "decoder": init_decoder_params(k_dec, config),
+        }
+    }
+    if "batch_stats" in cnn_vars:
+        out["batch_stats"] = cnn_vars["batch_stats"]
+    return out
+
+
+def encode(
+    variables: Dict[str, Any],
+    config: Config,
+    images: jnp.ndarray,
+    train: bool = False,
+) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """images [B,224,224,3] → contexts [B,N,D].  Returns (contexts, new_model_state).
+
+    train here means *the CNN is training* (train_cnn): enables BN batch
+    statistics and gradient flow; otherwise contexts are stop-gradiented so
+    the frozen CNN never enters the backward pass."""
+    encoder = make_encoder(config)
+    cnn_vars: Dict[str, Any] = {"params": variables["params"]["cnn"]}
+    if "batch_stats" in variables:
+        cnn_vars["batch_stats"] = variables["batch_stats"]
+
+    new_state: Dict[str, Any] = {}
+    if train and "batch_stats" in cnn_vars:
+        contexts, mutated = encoder.apply(
+            cnn_vars, images, train=True, mutable=["batch_stats"]
+        )
+        new_state["batch_stats"] = mutated["batch_stats"]
+    else:
+        contexts = encoder.apply(cnn_vars, images, train=False)
+    if not train:
+        contexts = jax.lax.stop_gradient(contexts)
+    return contexts, new_state
+
+
+def compute_loss(
+    variables: Dict[str, Any],
+    config: Config,
+    batch: Dict[str, jnp.ndarray],
+    rng: Optional[jax.Array] = None,
+    train: bool = True,
+) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Forward pass + the reference's total loss (model.py:293-334).
+
+    batch: images [B,224,224,3] (or precomputed 'contexts' [B,N,D]),
+    word_idxs [B,T] int32, masks [B,T] float32.
+    Returns (total_loss, aux) with aux carrying metrics, alphas, and any
+    mutated model state (BN stats).
+    """
+    train_cnn = train and config.train_cnn
+    if "contexts" in batch:
+        contexts, new_state = batch["contexts"], {}
+    else:
+        contexts, new_state = encode(variables, config, batch["images"], train_cnn)
+
+    sentences = batch["word_idxs"]
+    masks = batch["masks"].astype(jnp.float32)
+    B, T = sentences.shape
+    N = contexts.shape[1]
+
+    logits, alphas = teacher_forced_decode(
+        variables["params"]["decoder"], config, contexts, sentences, train, rng
+    )  # [B,T,V], [B,T,N]
+
+    # masked sparse softmax cross-entropy, summed / mask-sum (model.py:316-318)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ce = -jnp.take_along_axis(logp, sentences[..., None], axis=-1)[..., 0]  # [B,T]
+    mask_sum = masks.sum()
+    cross_entropy_loss = (ce * masks).sum() / mask_sum
+
+    # doubly stochastic attention penalty (model.py:320-326):
+    # alphas masked per-step, summed over time; penalize departure from 1
+    masked_alphas = alphas * masks[..., None]          # [B,T,N]
+    attentions = masked_alphas.sum(axis=1)             # [B,N]
+    diffs = 1.0 - attentions
+    attention_loss = (
+        config.attention_loss_factor * 0.5 * jnp.sum(diffs * diffs) / (B * N)
+    )
+
+    reg_loss = regularization_loss(
+        variables["params"],
+        fc_scale=config.fc_kernel_regularizer_scale if train else 0.0,
+        conv_scale=config.conv_kernel_regularizer_scale,
+        train_cnn=train_cnn,
+    )
+
+    total_loss = cross_entropy_loss + attention_loss + reg_loss
+
+    predictions = jnp.argmax(logits, axis=-1)
+    accuracy = ((predictions == sentences) * masks).sum() / mask_sum
+
+    aux = {
+        "metrics": {
+            "cross_entropy_loss": cross_entropy_loss,
+            "attention_loss": attention_loss,
+            "reg_loss": reg_loss,
+            "total_loss": total_loss,
+            "accuracy": accuracy,
+        },
+        "attentions": attentions,
+        "model_state": new_state,
+    }
+    return total_loss, aux

@@ -1,0 +1,339 @@
+"""Soft-attention LSTM caption decoder — pure-functional JAX.
+
+Re-design of the reference's build_rnn / initialize / attend / decode
+(/root/reference/model.py:190-459).  The reference unrolls 20 graph copies
+in Python and, at inference, runs ONE step per sess.run round-trip; here the
+decoder is a pure step function closed over an explicit parameter pytree, so
+
+* training is a single ``lax.scan`` over time (one compiled program),
+* beam search reuses the very same step function inside ``lax.scan`` fully
+  on device (sat_tpu/ops/beam_search.py),
+* the whole thing is trivially pjit/shard_map-compatible.
+
+Semantics preserved from the reference:
+* LSTM state initialized from the mean context via a 1- or 2-layer MLP
+  (model.py:358-393), with fc dropout on the inputs;
+* per-step soft attention, 1-layer additive logits (ctx→1 no-bias plus a
+  position-specific h→num_ctx no-bias projection) or 2-layer tanh MLP
+  (model.py:395-436), with fc dropout on both inputs;
+* LSTM input = concat(attention context, word embedding) (model.py:277),
+  TF1 LSTMCell gate order (i, j, f, o) with +1.0 forget-gate bias;
+* DropoutWrapper semantics (model.py:232-236): fresh per-step masks on the
+  LSTM input, emitted output, and the recurrent h (TF's default state
+  filter exempts the cell state c);
+* word logits from concat(output, context, word_embed) via a 1- or 2-layer
+  MLP (model.py:438-459);
+* teacher forcing: the step-t input word is sentences[:, t-1], step 0 gets
+  the <start> index 0 (model.py:253,310).
+
+Compute dtype: matmuls run in bfloat16 (MXU); softmax/log-softmax in fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+
+Params = Dict[str, Any]
+
+
+class DecoderState(NamedTuple):
+    """LSTM carry.  ``output`` is what the next attend/decode sees (the
+    DropoutWrapper's *output*-dropout h); ``recurrent`` is what the next
+    LSTM step consumes (the *state*-dropout h).  They are identical outside
+    training — the split mirrors reference model.py:232-236,307-309 where
+    last_output and last_state diverge under dropout."""
+
+    memory: jnp.ndarray      # LSTM cell state c, [B, H]
+    output: jnp.ndarray      # emitted h (feeds attend + decode), [B, H]
+    recurrent: jnp.ndarray   # recurrent h (feeds the next LSTM step), [B, H]
+
+
+# ---------------------------------------------------------------------------
+# Parameter initialization
+# ---------------------------------------------------------------------------
+
+
+def _uniform(key, shape, scale):
+    return jax.random.uniform(key, shape, jnp.float32, minval=-scale, maxval=scale)
+
+
+def _dense_params(key, d_in, d_out, scale, use_bias=True):
+    p = {"kernel": _uniform(key, (d_in, d_out), scale)}
+    if use_bias:
+        p["bias"] = jnp.zeros((d_out,), jnp.float32)
+    return p
+
+
+def init_decoder_params(rng: jax.Array, config: Config) -> Params:
+    """Build the decoder parameter pytree.  Leaf names mirror the reference
+    TF scopes (word_embedding/weights, lstm/kernel, initialize/fc_a2, ...)
+    so npy checkpoint import is a name rewrite, not a surgery."""
+    c = config
+    scale = c.fc_kernel_initializer_scale
+    E, H, D, N, V = (
+        c.dim_embedding,
+        c.num_lstm_units,
+        c.dim_ctx,
+        c.num_ctx,
+        c.vocabulary_size,
+    )
+    keys = iter(jax.random.split(rng, 16))
+    p: Params = {}
+
+    p["word_embedding"] = {"weights": _uniform(next(keys), (V, E), scale)}
+
+    # TF1 LSTMCell layout: one kernel [(input_dim + H), 4H], gates (i,j,f,o)
+    lstm_in = D + E
+    p["lstm"] = {
+        "kernel": _uniform(next(keys), (lstm_in + H, 4 * H), scale),
+        "bias": jnp.zeros((4 * H,), jnp.float32),
+    }
+
+    if c.num_initialize_layers == 1:
+        p["initialize"] = {
+            "fc_a": _dense_params(next(keys), D, H, scale),
+            "fc_b": _dense_params(next(keys), D, H, scale),
+        }
+    else:
+        di = c.dim_initialize_layer
+        p["initialize"] = {
+            "fc_a1": _dense_params(next(keys), D, di, scale),
+            "fc_a2": _dense_params(next(keys), di, H, scale),
+            "fc_b1": _dense_params(next(keys), D, di, scale),
+            "fc_b2": _dense_params(next(keys), di, H, scale),
+        }
+
+    if c.num_attend_layers == 1:
+        p["attend"] = {
+            "fc_a": _dense_params(next(keys), D, 1, scale, use_bias=False),
+            "fc_b": _dense_params(next(keys), H, N, scale, use_bias=False),
+        }
+    else:
+        da = c.dim_attend_layer
+        p["attend"] = {
+            "fc_1a": _dense_params(next(keys), D, da, scale),
+            "fc_1b": _dense_params(next(keys), H, da, scale),
+            "fc_2": _dense_params(next(keys), da, 1, scale, use_bias=False),
+        }
+
+    dec_in = H + D + E
+    if c.num_decode_layers == 1:
+        p["decode"] = {"fc": _dense_params(next(keys), dec_in, V, scale)}
+    else:
+        dd = c.dim_decode_layer
+        p["decode"] = {
+            "fc_1": _dense_params(next(keys), dec_in, dd, scale),
+            "fc_2": _dense_params(next(keys), dd, V, scale),
+        }
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def _dense(p, x, activation=None, dtype=jnp.bfloat16):
+    # dtype is the matmul compute dtype (bfloat16 on TPU → MXU)
+    y = x.astype(dtype) @ p["kernel"].astype(dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(dtype)
+    y = y.astype(jnp.float32)
+    if activation == "tanh":
+        y = jnp.tanh(y)
+    return y
+
+
+def _dropout(rng, x, rate, train):
+    if not train or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def lstm_step(
+    p: Params,
+    c: jnp.ndarray,
+    h: jnp.ndarray,
+    x: jnp.ndarray,
+    dtype=jnp.bfloat16,
+    forget_bias: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """TF1 LSTMCell: concat(x, h) @ kernel → (i, j, f, o).  Returns (c, h)."""
+    z = jnp.concatenate([x, h], axis=-1).astype(dtype) @ p["kernel"].astype(dtype)
+    z = z.astype(jnp.float32) + p["bias"]
+    i, j, f, o = jnp.split(z, 4, axis=-1)
+    new_c = jax.nn.sigmoid(f + forget_bias) * c + jax.nn.sigmoid(i) * jnp.tanh(j)
+    new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+    return new_c, new_h
+
+
+def init_state(
+    params: Params,
+    config: Config,
+    contexts: jnp.ndarray,
+    train: bool = False,
+    rng: Optional[jax.Array] = None,
+) -> DecoderState:
+    """LSTM state from the mean context (reference initialize, model.py:358-393)."""
+    p = params["initialize"]
+    rate = config.fc_drop_rate
+    dt = jnp.dtype(config.compute_dtype)
+    context_mean = contexts.mean(axis=1)
+    if train:
+        k0, k1, k2 = jax.random.split(rng, 3)
+        context_mean = _dropout(k0, context_mean, rate, train)
+    if config.num_initialize_layers == 1:
+        memory = _dense(p["fc_a"], context_mean, dtype=dt)
+        output = _dense(p["fc_b"], context_mean, dtype=dt)
+    else:
+        ta = _dense(p["fc_a1"], context_mean, activation="tanh", dtype=dt)
+        tb = _dense(p["fc_b1"], context_mean, activation="tanh", dtype=dt)
+        if train:
+            ta = _dropout(k1, ta, rate, train)
+            tb = _dropout(k2, tb, rate, train)
+        memory = _dense(p["fc_a2"], ta, dtype=dt)
+        output = _dense(p["fc_b2"], tb, dtype=dt)
+    return DecoderState(memory=memory, output=output, recurrent=output)
+
+
+def attend(
+    params: Params,
+    config: Config,
+    contexts: jnp.ndarray,
+    output: jnp.ndarray,
+    train: bool = False,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Soft attention over the context grid → alpha [B, N]
+    (reference attend, model.py:395-436)."""
+    p = params["attend"]
+    rate = config.fc_drop_rate
+    dt = jnp.dtype(config.compute_dtype)
+    if train:
+        kc, ko, kt = jax.random.split(rng, 3)
+        contexts = _dropout(kc, contexts, rate, train)
+        output = _dropout(ko, output, rate, train)
+    if config.num_attend_layers == 1:
+        # ctx→1 per position (no bias) + position-specific h→N projection
+        logits1 = _dense(p["fc_a"], contexts, dtype=dt)[..., 0]    # [B, N]
+        logits2 = _dense(p["fc_b"], output, dtype=dt)              # [B, N]
+        logits = logits1 + logits2
+    else:
+        t1 = _dense(p["fc_1a"], contexts, activation="tanh", dtype=dt)  # [B, N, da]
+        t2 = _dense(p["fc_1b"], output, activation="tanh", dtype=dt)    # [B, da]
+        temp = t1 + t2[:, None, :]
+        if train:
+            temp = _dropout(kt, temp, rate, train)
+        logits = _dense(p["fc_2"], temp, dtype=dt)[..., 0]     # [B, N]
+    return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+
+def decode_logits(
+    params: Params,
+    config: Config,
+    expanded_output: jnp.ndarray,
+    train: bool = False,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """concat(output, context, word_embed) → vocab logits
+    (reference decode, model.py:438-459)."""
+    p = params["decode"]
+    rate = config.fc_drop_rate
+    dt = jnp.dtype(config.compute_dtype)
+    if train:
+        k0, k1 = jax.random.split(rng)
+        expanded_output = _dropout(k0, expanded_output, rate, train)
+    if config.num_decode_layers == 1:
+        return _dense(p["fc"], expanded_output, dtype=dt)
+    temp = _dense(p["fc_1"], expanded_output, activation="tanh", dtype=dt)
+    if train:
+        temp = _dropout(k1, temp, rate, train)
+    return _dense(p["fc_2"], temp, dtype=dt)
+
+
+def decoder_step(
+    params: Params,
+    config: Config,
+    contexts: jnp.ndarray,
+    state: DecoderState,
+    word: jnp.ndarray,
+    train: bool = False,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[DecoderState, jnp.ndarray, jnp.ndarray]:
+    """One decoder step: attend → embed → LSTM → logits.
+
+    Returns (new_state, logits [B, V], alpha [B, N]).  ``state.output`` must
+    be the post-dropout h when training, matching the reference where the
+    DropoutWrapper's output feeds the next attend (model.py:262,307).
+    """
+    if train:
+        k_att, k_in, k_out, k_state, k_dec = jax.random.split(rng, 5)
+    else:
+        k_att = k_in = k_out = k_state = k_dec = None
+    ldr = config.lstm_drop_rate
+
+    alpha = attend(params, config, contexts, state.output, train, k_att)
+    context = (contexts * alpha[..., None]).sum(axis=1)          # [B, D]
+
+    word_embed = params["word_embedding"]["weights"][word]        # [B, E]
+
+    lstm_input = jnp.concatenate([context, word_embed], axis=-1)
+    lstm_input = _dropout(k_in, lstm_input, ldr, train)
+    new_c, new_h = lstm_step(
+        params["lstm"], state.memory, state.recurrent, lstm_input,
+        dtype=jnp.dtype(config.compute_dtype),
+    )
+    # DropoutWrapper: independent masks on emitted h and recurrent h; c exempt
+    emitted = _dropout(k_out, new_h, ldr, train)
+    recurrent_h = _dropout(k_state, new_h, ldr, train)
+
+    expanded = jnp.concatenate([emitted, context, word_embed], axis=-1)
+    logits = decode_logits(params, config, expanded, train, k_dec)
+
+    return DecoderState(memory=new_c, output=emitted, recurrent=recurrent_h), logits, alpha
+
+
+def teacher_forced_decode(
+    params: Params,
+    config: Config,
+    contexts: jnp.ndarray,
+    sentences: jnp.ndarray,
+    train: bool = False,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Full training-time unroll as one lax.scan.
+
+    contexts [B, N, D]; sentences [B, T] int32.
+    Returns (logits [B, T, V], alphas [B, T, N]).
+    """
+    B, T = sentences.shape
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    k_init, k_steps = jax.random.split(rng)
+    state = init_state(params, config, contexts, train, k_init)
+
+    # input word at step t is sentences[:, t-1]; step 0 gets <start>=0
+    words_in = jnp.concatenate(
+        [jnp.zeros((B, 1), sentences.dtype), sentences[:, :-1]], axis=1
+    )
+    step_rngs = jax.random.split(k_steps, T)
+
+    def body(state, xs):
+        word_t, rng_t = xs
+        state, logits, alpha = decoder_step(
+            params, config, contexts, state, word_t, train, rng_t
+        )
+        return state, (logits, alpha)
+
+    _, (logits, alphas) = jax.lax.scan(
+        body, state, (words_in.T, step_rngs)
+    )
+    # scan stacks along time-major; restore batch-major
+    return logits.transpose(1, 0, 2), alphas.transpose(1, 0, 2)

@@ -1,0 +1,137 @@
+"""ResNet50 encoder → 49×2048 spatial context grid.
+
+Same topology as the reference's build_resnet50
+(/root/reference/model.py:62-188): conv1 7×7/2 + BN + relu + 3×3/2 maxpool,
+then bottleneck stages res2(a..c) / res3(a..d) / res4(a..f) / res5(a..c).
+Stage-opening blocks use a projection shortcut (reference ``resnet_block``,
+model.py:111-153; res2a has stride 1, the rest stride 2), remaining blocks
+an identity shortcut (``resnet_block2``, model.py:155-188).  res5c's
+7×7×2048 map is reshaped to [B, 49, 2048].
+
+Module names mirror the reference's scope names (res2a_branch2a,
+bn2a_branch2a, …) for pretrained ``resnet50_no_fc.npy`` import.
+
+Batch norm runs in inference mode (moving statistics) unless the CNN is
+being trained, matching utils/nn.py:116-125; when train_cnn=True callers
+must make the 'batch_stats' collection mutable.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..nn.layers import Conv, max_pool2d
+
+NUM_CTX = 49
+DIM_CTX = 2048
+
+
+class BottleneckProjection(nn.Module):
+    """Stage-opening bottleneck with projection shortcut
+    (reference resnet_block, model.py:111-153)."""
+
+    features: int          # bottleneck width c; output is 4c
+    strides: int = 2
+    stage: str = "2a"      # names like res2a_branch2a / bn2a_branch2a
+    use_running_average: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c, s, st = self.features, self.strides, self.stage
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=self.use_running_average,
+            momentum=0.99, epsilon=1e-3, name=name, **kw,
+        )
+        conv = lambda f, k, stride, name: Conv(  # noqa: E731
+            features=f, kernel_size=(k, k), strides=(stride, stride),
+            activation=None, use_bias=False, name=name, **kw,
+        )
+
+        branch1 = bn(f"bn{st}_branch1")(conv(4 * c, 1, s, f"res{st}_branch1")(x))
+
+        y = nn.relu(bn(f"bn{st}_branch2a")(conv(c, 1, s, f"res{st}_branch2a")(x)))
+        y = nn.relu(bn(f"bn{st}_branch2b")(conv(c, 3, 1, f"res{st}_branch2b")(y)))
+        y = bn(f"bn{st}_branch2c")(conv(4 * c, 1, 1, f"res{st}_branch2c")(y))
+        return nn.relu(branch1 + y)
+
+
+class BottleneckIdentity(nn.Module):
+    """Identity-shortcut bottleneck (reference resnet_block2, model.py:155-188)."""
+
+    features: int
+    stage: str = "2b"
+    use_running_average: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c, st = self.features, self.stage
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=self.use_running_average,
+            momentum=0.99, epsilon=1e-3, name=name, **kw,
+        )
+        conv = lambda f, k, name: Conv(  # noqa: E731
+            features=f, kernel_size=(k, k), strides=(1, 1),
+            activation=None, use_bias=False, name=name, **kw,
+        )
+
+        y = nn.relu(bn(f"bn{st}_branch2a")(conv(c, 1, f"res{st}_branch2a")(x)))
+        y = nn.relu(bn(f"bn{st}_branch2b")(conv(c, 3, f"res{st}_branch2b")(y)))
+        y = bn(f"bn{st}_branch2c")(conv(4 * c, 1, f"res{st}_branch2c")(y))
+        return nn.relu(x + y)
+
+
+_STAGES = [
+    # (stage prefix, width, num identity blocks, first-block stride)
+    ("2", 64, 2, 1),
+    ("3", 128, 3, 2),
+    ("4", 256, 5, 2),
+    ("5", 512, 2, 2),
+]
+
+
+class ResNet50(nn.Module):
+    use_running_average: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        """images: [B, 224, 224, 3] float32 → contexts [B, 49, 2048] fp32."""
+        ura = self.use_running_average and not train
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+
+        x = images.astype(self.dtype)
+        x = Conv(
+            features=64, kernel_size=(7, 7), strides=(2, 2),
+            activation=None, name="conv1", **kw,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=ura, momentum=0.99, epsilon=1e-3,
+            name="bn_conv1", **kw,
+        )(x)
+        x = nn.relu(x)
+        x = max_pool2d(x, pool_size=(3, 3), strides=(2, 2))
+
+        for prefix, width, n_identity, stride in _STAGES:
+            x = BottleneckProjection(
+                features=width, strides=stride, stage=f"{prefix}a",
+                use_running_average=ura, name=f"res{prefix}a", **kw,
+            )(x)
+            for i in range(n_identity):
+                letter = chr(ord("b") + i)
+                x = BottleneckIdentity(
+                    features=width, stage=f"{prefix}{letter}",
+                    use_running_average=ura, name=f"res{prefix}{letter}", **kw,
+                )(x)
+
+        b = x.shape[0]
+        return x.reshape(b, NUM_CTX, DIM_CTX).astype(jnp.float32)

@@ -1,0 +1,53 @@
+"""VGG16 encoder → 196×512 spatial context grid.
+
+Same topology as the reference's build_vgg16 (/root/reference/model.py:24-60):
+13 'same'-padded 3×3 convs in 5 blocks, max-pool after the first 4 blocks,
+conv5_3's 14×14×512 map reshaped to a [B, 196, 512] context grid.  Module
+names match the reference's TF scopes (conv1_1 … conv5_3) so pretrained
+``vgg16_no_fc.npy`` checkpoints map 1:1 (see sat_tpu.train.checkpoint).
+
+TPU notes: NHWC layout, bfloat16 conv compute on the MXU, fp32 output for
+the attention softmax downstream.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..nn.layers import Conv, max_pool2d
+
+# (name, features, pool_after)
+_VGG_LAYERS = [
+    ("conv1_1", 64, False), ("conv1_2", 64, True),
+    ("conv2_1", 128, False), ("conv2_2", 128, True),
+    ("conv3_1", 256, False), ("conv3_2", 256, False), ("conv3_3", 256, True),
+    ("conv4_1", 512, False), ("conv4_2", 512, False), ("conv4_3", 512, True),
+    ("conv5_1", 512, False), ("conv5_2", 512, False), ("conv5_3", 512, False),
+]
+
+NUM_CTX = 196
+DIM_CTX = 512
+
+
+class VGG16(nn.Module):
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        """images: [B, 224, 224, 3] float32 → contexts [B, 196, 512] fp32."""
+        x = images.astype(self.dtype)
+        for name, features, pool_after in _VGG_LAYERS:
+            x = Conv(
+                features=features,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name=name,
+            )(x)
+            if pool_after:
+                x = max_pool2d(x)
+        b = x.shape[0]
+        return x.reshape(b, NUM_CTX, DIM_CTX).astype(jnp.float32)

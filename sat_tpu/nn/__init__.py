@@ -1,0 +1,21 @@
+from .layers import (
+    BatchNorm,
+    Conv,
+    Dense,
+    conv_kernel_init,
+    dropout,
+    fc_kernel_init,
+    max_pool2d,
+    regularization_loss,
+)
+
+__all__ = [
+    "BatchNorm",
+    "Conv",
+    "Dense",
+    "conv_kernel_init",
+    "dropout",
+    "fc_kernel_init",
+    "max_pool2d",
+    "regularization_loss",
+]

@@ -1,0 +1,20 @@
+from .optimizer import make_learning_rate, make_optimizer
+from .step import (
+    TrainState,
+    create_train_state,
+    make_eval_loss_step,
+    make_jit_train_step,
+    make_train_step,
+    split_trainable,
+)
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_eval_loss_step",
+    "make_jit_train_step",
+    "make_train_step",
+    "make_learning_rate",
+    "make_optimizer",
+    "split_trainable",
+]

@@ -1,0 +1,59 @@
+"""Optimizer factory.
+
+Equivalent of the reference build_optimizer (/root/reference/model.py:461-513):
+Adam / RMSProp / Momentum(+Nesterov) / SGD selected by config string, wrapped
+with global-norm gradient clipping (clip_gradients=5.0) and optional
+staircase exponential learning-rate decay — the same composition TF's
+``optimize_loss`` applied (clip first, then the optimizer update).
+"""
+
+from __future__ import annotations
+
+import optax
+
+from ..config import Config
+
+
+def make_learning_rate(config: Config):
+    if config.learning_rate_decay_factor < 1.0:
+        return optax.exponential_decay(
+            init_value=config.initial_learning_rate,
+            transition_steps=config.num_steps_per_decay,
+            decay_rate=config.learning_rate_decay_factor,
+            staircase=True,
+        )
+    return config.initial_learning_rate
+
+
+def make_optimizer(config: Config) -> optax.GradientTransformation:
+    lr = make_learning_rate(config)
+    name = config.optimizer
+    if name == "Adam":
+        opt = optax.adam(
+            learning_rate=lr,
+            b1=config.beta1,
+            b2=config.beta2,
+            eps=config.epsilon,
+        )
+    elif name == "RMSProp":
+        opt = optax.rmsprop(
+            learning_rate=lr,
+            decay=config.decay,
+            eps=config.epsilon,
+            centered=config.centered,
+            momentum=config.momentum,
+        )
+    elif name == "Momentum":
+        opt = optax.sgd(
+            learning_rate=lr,
+            momentum=config.momentum,
+            nesterov=config.use_nesterov,
+        )
+    else:  # 'SGD'
+        opt = optax.sgd(learning_rate=lr)
+
+    transforms = []
+    if config.clip_gradients and config.clip_gradients > 0:
+        transforms.append(optax.clip_by_global_norm(config.clip_gradients))
+    transforms.append(opt)
+    return optax.chain(*transforms)

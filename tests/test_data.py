@@ -122,7 +122,7 @@ class TestCoco:
             [{"image_id": 1, "caption": "a dog."}, {"image_id": 2, "caption": "a cat."}]
         )
         assert len(res.imgs) == 2
-        with pytest.raises(AssertionError):
+        with pytest.raises(ValueError):
             coco.load_results([{"image_id": 99999, "caption": "x."}])
 
 

@@ -1,0 +1,252 @@
+"""Model layer tests: encoders, decoder math, losses, train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sat_tpu.config import Config
+from sat_tpu.models import (
+    DecoderState,
+    attend,
+    decoder_step,
+    init_decoder_params,
+    init_state,
+    lstm_step,
+    teacher_forced_decode,
+)
+from sat_tpu.models.captioner import compute_loss, init_variables
+from sat_tpu.nn.layers import regularization_loss
+from sat_tpu.train import create_train_state, make_jit_train_step
+
+
+def tiny_config(**kw) -> Config:
+    base = dict(
+        cnn="vgg16",
+        vocabulary_size=50,
+        dim_embedding=16,
+        num_lstm_units=24,
+        dim_initialize_layer=16,
+        dim_attend_layer=16,
+        dim_decode_layer=32,
+        max_caption_length=8,
+        batch_size=4,
+        compute_dtype="float32",
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def tiny_contexts_batch(cfg, rng_seed=0):
+    rng = np.random.default_rng(rng_seed)
+    B, T = cfg.batch_size, cfg.max_caption_length
+    contexts = jnp.asarray(rng.normal(size=(B, cfg.num_ctx, cfg.dim_ctx)), jnp.float32)
+    sentences = jnp.asarray(rng.integers(1, cfg.vocabulary_size, (B, T)), jnp.int32)
+    masks = np.ones((B, T), np.float32)
+    masks[:, T - 2 :] = 0.0
+    return {"contexts": contexts, "word_idxs": sentences, "masks": jnp.asarray(masks)}
+
+
+class TestLSTM:
+    def test_matches_manual_numpy(self):
+        H, I = 4, 3
+        rng = np.random.default_rng(0)
+        kernel = rng.normal(size=(I + H, 4 * H)).astype(np.float32)
+        bias = rng.normal(size=(4 * H,)).astype(np.float32)
+        c = rng.normal(size=(2, H)).astype(np.float32)
+        h = rng.normal(size=(2, H)).astype(np.float32)
+        x = rng.normal(size=(2, I)).astype(np.float32)
+
+        def sigmoid(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        z = np.concatenate([x, h], -1) @ kernel + bias
+        i, j, f, o = np.split(z, 4, -1)
+        exp_c = sigmoid(f + 1.0) * c + sigmoid(i) * np.tanh(j)
+        exp_h = sigmoid(o) * np.tanh(exp_c)
+
+        new_c, new_h = lstm_step(
+            {"kernel": jnp.asarray(kernel), "bias": jnp.asarray(bias)},
+            jnp.asarray(c), jnp.asarray(h), jnp.asarray(x), dtype=jnp.float32,
+        )
+        np.testing.assert_allclose(new_c, exp_c, rtol=1e-5)
+        np.testing.assert_allclose(new_h, exp_h, rtol=1e-5)
+
+
+class TestDecoder:
+    @pytest.mark.parametrize("n_layers", [1, 2])
+    def test_attention_shapes_and_simplex(self, n_layers):
+        cfg = tiny_config(num_attend_layers=n_layers)
+        params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        contexts = jnp.ones((4, cfg.num_ctx, cfg.dim_ctx))
+        output = jnp.ones((4, cfg.num_lstm_units))
+        alpha = attend(params, cfg, contexts, output)
+        assert alpha.shape == (4, cfg.num_ctx)
+        np.testing.assert_allclose(alpha.sum(-1), np.ones(4), rtol=1e-5)
+        assert (np.asarray(alpha) >= 0).all()
+
+    @pytest.mark.parametrize("n_layers", [1, 2])
+    def test_init_state_shapes(self, n_layers):
+        cfg = tiny_config(num_initialize_layers=n_layers)
+        params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        contexts = jnp.ones((4, cfg.num_ctx, cfg.dim_ctx))
+        state = init_state(params, cfg, contexts)
+        assert state.memory.shape == (4, cfg.num_lstm_units)
+        assert state.output.shape == (4, cfg.num_lstm_units)
+        np.testing.assert_allclose(state.output, state.recurrent)
+
+    def test_scan_matches_stepwise_unroll(self):
+        """lax.scan teacher forcing == manual python unroll (eval mode)."""
+        cfg = tiny_config()
+        params = init_decoder_params(jax.random.PRNGKey(1), cfg)
+        batch = tiny_contexts_batch(cfg)
+        contexts, sentences = batch["contexts"], batch["word_idxs"]
+
+        logits_scan, alphas_scan = teacher_forced_decode(
+            params, cfg, contexts, sentences, train=False
+        )
+
+        state = init_state(params, cfg, contexts)
+        B, T = sentences.shape
+        words_in = jnp.concatenate(
+            [jnp.zeros((B, 1), sentences.dtype), sentences[:, :-1]], 1
+        )
+        for t in range(T):
+            state, logits_t, alpha_t = decoder_step(
+                params, cfg, contexts, state, words_in[:, t]
+            )
+            np.testing.assert_allclose(
+                logits_scan[:, t], logits_t, rtol=2e-4, atol=2e-4
+            )
+            np.testing.assert_allclose(alphas_scan[:, t], alpha_t, rtol=2e-4, atol=2e-4)
+
+    def test_decode_layers_variants(self):
+        for n in (1, 2):
+            cfg = tiny_config(num_decode_layers=n)
+            params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+            batch = tiny_contexts_batch(cfg)
+            logits, alphas = teacher_forced_decode(
+                params, cfg, batch["contexts"], batch["word_idxs"]
+            )
+            assert logits.shape == (4, cfg.max_caption_length, cfg.vocabulary_size)
+            assert alphas.shape == (4, cfg.max_caption_length, cfg.num_ctx)
+
+    def test_dropout_only_in_train(self):
+        cfg = tiny_config()
+        params = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        batch = tiny_contexts_batch(cfg)
+        l1, _ = teacher_forced_decode(params, cfg, batch["contexts"], batch["word_idxs"])
+        l2, _ = teacher_forced_decode(params, cfg, batch["contexts"], batch["word_idxs"])
+        np.testing.assert_allclose(l1, l2)  # deterministic without train
+        l3, _ = teacher_forced_decode(
+            params, cfg, batch["contexts"], batch["word_idxs"],
+            train=True, rng=jax.random.PRNGKey(7),
+        )
+        assert not np.allclose(l1, l3)
+
+
+class TestLoss:
+    def test_masking_excludes_padded_steps(self):
+        cfg = tiny_config()
+        variables = {"params": {"cnn": {}, "decoder": init_decoder_params(jax.random.PRNGKey(0), cfg)}}
+        batch = tiny_contexts_batch(cfg)
+        total, aux = compute_loss(variables, cfg, batch, train=False)
+        m = aux["metrics"]
+        assert np.isfinite(total)
+        # change labels only in masked-out positions: loss identical
+        w = np.asarray(batch["word_idxs"]).copy()
+        w[:, -1] = (w[:, -1] + 1) % cfg.vocabulary_size
+        batch2 = dict(batch, word_idxs=jnp.asarray(w))
+        total2, _ = compute_loss(variables, cfg, batch2, train=False)
+        np.testing.assert_allclose(total, total2, rtol=1e-6)
+        assert 0.0 <= float(m["accuracy"]) <= 1.0
+
+    def test_attention_loss_zero_when_alphas_sum_to_one_per_masked_steps(self):
+        # with factor 0 the term vanishes
+        cfg = tiny_config(attention_loss_factor=0.0)
+        variables = {"params": {"cnn": {}, "decoder": init_decoder_params(jax.random.PRNGKey(0), cfg)}}
+        batch = tiny_contexts_batch(cfg)
+        _, aux = compute_loss(variables, cfg, batch, train=False)
+        assert float(aux["metrics"]["attention_loss"]) == 0.0
+
+    def test_reg_loss_accounting(self):
+        cfg = tiny_config()
+        dec = init_decoder_params(jax.random.PRNGKey(0), cfg)
+        params = {"cnn": {"conv1_1": {"conv": {"kernel": jnp.ones((3, 3, 3, 4)), "bias": jnp.ones((4,))}}},
+                  "decoder": dec}
+        # frozen CNN: conv kernels excluded
+        r_frozen = regularization_loss(params, fc_scale=1e-4, conv_scale=1e-4, train_cnn=False)
+        r_train = regularization_loss(params, fc_scale=1e-4, conv_scale=1e-4, train_cnn=True)
+        conv_term = 0.5 * 1e-4 * 3 * 3 * 3 * 4
+        np.testing.assert_allclose(float(r_train) - float(r_frozen), conv_term, rtol=1e-5)
+        # lstm kernel never regularized
+        no_lstm = jax.tree_util.tree_map(lambda x: x, params)
+        no_lstm["decoder"] = {k: v for k, v in dec.items() if k != "lstm"}
+        np.testing.assert_allclose(
+            float(regularization_loss(no_lstm, 1e-4, 1e-4, False)),
+            float(r_frozen), rtol=1e-6,
+        )
+
+
+class TestEncoders:
+    def test_vgg16_context_grid(self):
+        from sat_tpu.models import VGG16
+
+        m = VGG16(dtype=jnp.float32)
+        variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)))
+        out = m.apply(variables, jnp.ones((1, 224, 224, 3)))
+        assert out.shape == (1, 196, 512)
+        assert "conv1_1" in variables["params"] and "conv5_3" in variables["params"]
+
+    def test_resnet50_context_grid(self):
+        from sat_tpu.models import ResNet50
+
+        m = ResNet50(dtype=jnp.float32)
+        variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)))
+        out = m.apply(variables, jnp.ones((1, 224, 224, 3)))
+        assert out.shape == (1, 49, 2048)
+        assert "batch_stats" in variables
+        p = variables["params"]
+        assert "conv1" in p and "res2a" in p and "res5c" in p
+        assert "res5c_branch2c" in p["res5c"]
+
+
+class TestTrainStep:
+    def test_loss_decreases_decoder_only(self):
+        cfg = tiny_config(initial_learning_rate=5e-3)
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        # bypass the CNN with precomputed contexts: frozen-CNN training mode
+        step = make_jit_train_step(cfg)
+        batch = tiny_contexts_batch(cfg)
+        rngs = jax.random.split(jax.random.PRNGKey(42), 60)
+        first = None
+        for i in range(60):
+            state, metrics = step(state, batch, rngs[i])
+            if first is None:
+                first = float(metrics["total_loss"])
+        last = float(metrics["total_loss"])
+        assert last < first * 0.7, (first, last)
+        assert int(state.step) == 60
+
+    def test_frozen_cnn_params_unchanged(self):
+        cfg = tiny_config()
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        cnn_before = jax.tree_util.tree_map(np.asarray, state.params["cnn"])
+        step = make_jit_train_step(cfg)
+        batch = tiny_contexts_batch(cfg)
+        state, _ = step(state, batch, jax.random.PRNGKey(1))
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+            cnn_before, state.params["cnn"],
+        )
+
+    def test_optimizer_variants_build(self):
+        from sat_tpu.train import make_optimizer
+
+        for name in ("Adam", "RMSProp", "Momentum", "SGD"):
+            cfg = tiny_config(optimizer=name)
+            opt = make_optimizer(cfg)
+            params = {"w": jnp.ones((3,))}
+            opt_state = opt.init(params)
+            updates, _ = opt.update({"w": jnp.ones((3,))}, opt_state, params)
+            assert updates["w"].shape == (3,)
