@@ -20,8 +20,10 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
 
-@dataclass
+@dataclass(frozen=True)
 class Config:
+    """Immutable (hashable) so a Config can ride through jax.jit as a
+    static argument; use .replace(...) to derive variants."""
     # ---- architecture (reference config.py:8-17) ----
     cnn: str = "vgg16"                 # 'vgg16' or 'resnet50'
     max_caption_length: int = 20

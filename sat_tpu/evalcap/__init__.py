@@ -1,0 +1,7 @@
+from .bleu import Bleu
+from .cider import Cider
+from .eval import CocoEvalCap
+from .meteor import Meteor
+from .rouge import Rouge
+
+__all__ = ["Bleu", "Cider", "CocoEvalCap", "Meteor", "Rouge"]

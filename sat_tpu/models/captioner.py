@@ -94,6 +94,17 @@ def compute_loss(
     Returns (total_loss, aux) with aux carrying metrics, alphas, and any
     mutated model state (BN stats).
     """
+    if train and rng is None:
+        raise ValueError("compute_loss(train=True) requires an rng for dropout")
+    if (
+        config.fc_activity_regularizer_scale > 0
+        or config.conv_activity_regularizer_scale > 0
+    ):
+        raise NotImplementedError(
+            "L1 activity regularization (reference utils/nn.py:23-26,40-43) is "
+            "not implemented; both scales default to 0.0 in the reference too. "
+            "Set them to 0."
+        )
     train_cnn = train and config.train_cnn
     if "contexts" in batch:
         contexts, new_state = batch["contexts"], {}

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 
 from ..config import Config
+from ..nn.layers import dropout as _nn_dropout
+from ..nn.layers import fc_kernel_init
 
 Params = Dict[str, Any]
 
@@ -59,7 +61,7 @@ class DecoderState(NamedTuple):
 
 
 def _uniform(key, shape, scale):
-    return jax.random.uniform(key, shape, jnp.float32, minval=-scale, maxval=scale)
+    return fc_kernel_init(scale)(key, shape)
 
 
 def _dense_params(key, d_in, d_out, scale, use_bias=True):
@@ -150,11 +152,7 @@ def _dense(p, x, activation=None, dtype=jnp.bfloat16):
 
 
 def _dropout(rng, x, rate, train):
-    if not train or rate <= 0.0:
-        return x
-    keep = 1.0 - rate
-    mask = jax.random.bernoulli(rng, keep, x.shape)
-    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+    return _nn_dropout(x, rate, deterministic=not train, rng=rng)
 
 
 def lstm_step(
@@ -315,7 +313,12 @@ def teacher_forced_decode(
     """
     B, T = sentences.shape
     if rng is None:
-        rng = jax.random.PRNGKey(0)
+        if train:
+            raise ValueError(
+                "teacher_forced_decode(train=True) requires an rng; a fixed "
+                "key would silently reuse identical dropout masks every step"
+            )
+        rng = jax.random.PRNGKey(0)  # never consumed when train=False
     k_init, k_steps = jax.random.split(rng)
     state = init_state(params, config, contexts, train, k_init)
 

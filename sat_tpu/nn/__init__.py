@@ -1,7 +1,5 @@
 from .layers import (
-    BatchNorm,
     Conv,
-    Dense,
     conv_kernel_init,
     dropout,
     fc_kernel_init,
@@ -10,9 +8,7 @@ from .layers import (
 )
 
 __all__ = [
-    "BatchNorm",
     "Conv",
-    "Dense",
     "conv_kernel_init",
     "dropout",
     "fc_kernel_init",

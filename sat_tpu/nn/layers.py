@@ -65,56 +65,9 @@ class Conv(nn.Module):
         return x
 
 
-class Dense(nn.Module):
-    """fc layer, default tanh activation (reference utils/nn.py:85-105)."""
-
-    features: int
-    activation: Optional[str] = "tanh"
-    use_bias: bool = True
-    init_scale: float = 0.08
-    dtype: Dtype = jnp.bfloat16
-    param_dtype: Dtype = jnp.float32
-
-    @nn.compact
-    def __call__(self, x):
-        x = nn.Dense(
-            features=self.features,
-            use_bias=self.use_bias,
-            kernel_init=fc_kernel_init(self.init_scale),
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            name="fc",
-        )(x)
-        if self.activation == "tanh":
-            x = jnp.tanh(x)
-        elif self.activation == "relu":
-            x = nn.relu(x)
-        return x
-
-
 def max_pool2d(x, pool_size=(2, 2), strides=(2, 2)):
     """'same'-padded max pool (reference utils/nn.py:72-83)."""
     return nn.max_pool(x, window_shape=pool_size, strides=strides, padding="SAME")
-
-
-class BatchNorm(nn.Module):
-    """TF1-default batch norm (reference utils/nn.py:116-125):
-    momentum 0.99, epsilon 1e-3; uses batch stats only while training."""
-
-    use_running_average: bool = True
-    dtype: Dtype = jnp.bfloat16
-    param_dtype: Dtype = jnp.float32
-
-    @nn.compact
-    def __call__(self, x):
-        return nn.BatchNorm(
-            use_running_average=self.use_running_average,
-            momentum=0.99,
-            epsilon=1e-3,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            name="bn",
-        )(x)
 
 
 def dropout(x, rate: float, deterministic: bool, rng=None):

@@ -1,0 +1,3 @@
+from .beam_search import BeamResult, beam_search, beam_search_jit, greedy_decode
+
+__all__ = ["BeamResult", "beam_search", "beam_search_jit", "greedy_decode"]

@@ -1,0 +1,244 @@
+"""Beam search tests: greedy oracle, numpy step-wise oracle, reference-style
+host-heap oracle (the algorithm of reference base_model.py:163-240
+re-implemented as a correctness baseline), and the no-completion fallback."""
+
+import heapq
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sat_tpu.config import Config
+from sat_tpu.models.decoder import decoder_step, init_decoder_params, init_state
+from sat_tpu.ops import beam_search, greedy_decode
+
+
+def tiny_config(**kw) -> Config:
+    base = dict(
+        cnn="vgg16",
+        vocabulary_size=30,
+        dim_embedding=12,
+        num_lstm_units=16,
+        dim_initialize_layer=12,
+        dim_attend_layer=12,
+        dim_decode_layer=24,
+        max_caption_length=6,
+        batch_size=3,
+        beam_size=3,
+        compute_dtype="float32",
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+EOS = 2  # pretend '.' lives at index 2
+
+
+def setup(seed=0, B=3, **kw):
+    cfg = tiny_config(**kw)
+    params = init_decoder_params(jax.random.PRNGKey(seed), cfg)
+    contexts = jnp.asarray(
+        np.random.default_rng(seed).normal(size=(B, cfg.num_ctx, cfg.dim_ctx)),
+        jnp.float32,
+    )
+    return cfg, params, contexts
+
+
+def host_step(params, cfg, contexts, state, words):
+    """One decoder step on host, returning (state, log-probs)."""
+    state, logits, _ = decoder_step(
+        params, cfg, contexts, state, jnp.asarray(words, jnp.int32), train=False
+    )
+    return state, np.asarray(jax.nn.log_softmax(logits, axis=-1))
+
+
+class TestGreedy:
+    def test_greedy_matches_argmax_rollout(self):
+        cfg, params, contexts = setup()
+        res = greedy_decode(params, cfg, contexts, eos_id=EOS)
+        B, T = contexts.shape[0], cfg.max_caption_length
+
+        state = init_state(params, cfg, contexts)
+        words = np.zeros((B,), np.int32)
+        done = np.zeros((B,), bool)
+        out = np.zeros((B, T), np.int32)
+        logp_total = np.zeros((B,), np.float64)
+        for t in range(T):
+            state, logp = host_step(params, cfg, contexts, state, words)
+            # greedy == beam 1: continuation excludes eos; eos closes the beam
+            for b in range(B):
+                if done[b]:
+                    continue
+                best = int(np.argmax(logp[b]))
+                if best == EOS:
+                    out[b, t] = EOS
+                    logp_total[b] += logp[b, EOS]
+                    done[b] = True
+                else:
+                    cont = logp[b].copy()
+                    cont[EOS] = -np.inf
+                    w = int(np.argmax(cont))
+                    out[b, t] = w
+                    logp_total[b] += cont[w]
+                    words[b] = w
+
+        got = np.asarray(res.words[:, 0])
+        for b in range(B):
+            L = int(res.lengths[b, 0])
+            finished = EOS in out[b]
+            if finished:
+                exp_len = int(np.argmax(out[b] == EOS)) + 1
+                assert L == exp_len
+                np.testing.assert_array_equal(got[b, :L], out[b, :L])
+
+
+class TestBeamOracle:
+    def _numpy_beam(self, cfg, params, contexts, K, T):
+        """Step-wise numpy implementation of OUR semantics (global top-K,
+        log-space, eos completes)."""
+        B = contexts.shape[0]
+        V = cfg.vocabulary_size
+        state0 = init_state(params, cfg, contexts)
+        # replicate per beam via flat batch
+        ctx_rep = jnp.repeat(contexts, K, axis=0)
+        state = type(state0)(*(jnp.repeat(s, K, axis=0) for s in state0))
+        live_logp = np.full((B, K), -1e30)
+        live_logp[:, 0] = 0.0
+        live_words = np.zeros((B, K, T), np.int32)
+        live_len = np.zeros((B, K), np.int32)
+        last = np.zeros((B, K), np.int32)
+        fin = [[] for _ in range(B)]  # list of (logp, words, len)
+
+        for t in range(T):
+            state, step_logp = host_step(
+                params, cfg, ctx_rep, state, last.reshape(-1)
+            )
+            step_logp = step_logp.reshape(B, K, V)
+            logp = step_logp + live_logp[..., None]
+            for b in range(B):
+                # completions — gated on eos being in the beam's top-(K+1)
+                for k in range(K):
+                    kth = np.sort(step_logp[b, k])[-min(K + 1, V)]
+                    if step_logp[b, k, EOS] < kth:
+                        continue
+                    w = live_words[b, k].copy()
+                    w[t] = EOS
+                    fin[b].append((logp[b, k, EOS], w, live_len[b, k] + 1))
+                fin[b] = sorted(fin[b], key=lambda x: -x[0])[:K]
+            cont = logp.copy()
+            cont[:, :, EOS] = -np.inf
+            flat = cont.reshape(B, K * V)
+            sel = np.argsort(-flat, axis=1)[:, :K]
+            parent, word = sel // V, sel % V
+            new_words = np.zeros_like(live_words)
+            new_len = np.zeros_like(live_len)
+            ns = [np.asarray(s).reshape(B, K, -1) for s in state]
+            picked = [np.zeros_like(s) for s in ns]
+            for b in range(B):
+                for k in range(K):
+                    p = parent[b, k]
+                    new_words[b, k] = live_words[b, p]
+                    new_words[b, k, t] = word[b, k]
+                    new_len[b, k] = live_len[b, p] + 1
+                    for i in range(3):
+                        picked[i][b, k] = ns[i][b, p]
+                live_logp[b] = flat[b, sel[b]]
+            live_words, live_len, last = new_words, new_len, word.astype(np.int32)
+            state = type(state0)(
+                *(jnp.asarray(p.reshape(B * K, -1), jnp.float32) for p in picked)
+            )
+        return fin
+
+    def test_matches_numpy_oracle(self):
+        cfg, params, contexts = setup(seed=3)
+        # nudge eos into contention so completions actually happen
+        bias = np.asarray(params["decode"]["fc_2"]["bias"]).copy()
+        bias[EOS] += 1.5
+        params["decode"]["fc_2"]["bias"] = jnp.asarray(bias)
+        K, T = cfg.beam_size, cfg.max_caption_length
+        res = beam_search(params, cfg, contexts, eos_id=EOS)
+        fin = self._numpy_beam(cfg, params, contexts, K, T)
+        for b in range(contexts.shape[0]):
+            assert fin[b], "oracle found no completions; reseed the test"
+            n = len(fin[b])
+            exp_scores = [s for s, _, _ in fin[b]]
+            np.testing.assert_allclose(
+                np.asarray(res.log_scores[b, :n]), exp_scores, rtol=1e-4, atol=1e-4
+            )
+            best_words = fin[b][0][1]
+            L = fin[b][0][2]
+            np.testing.assert_array_equal(
+                np.asarray(res.words[b, 0, :L]), best_words[:L]
+            )
+
+    def test_at_least_as_good_as_reference_heap_semantics(self):
+        """Reference algorithm (per-beam top-(K+1), prob products, TopN
+        heaps) re-implemented on host; our global-top-K search must find a
+        best caption with score >= the reference's."""
+        cfg, params, contexts = setup(seed=11)
+        K, T = cfg.beam_size, cfg.max_caption_length
+        B = contexts.shape[0]
+        state0 = init_state(params, cfg, contexts)
+
+        # ---- reference-style host search (one image at a time) ----
+        ref_best = []
+        for b in range(B):
+            ctx_b = contexts[b : b + 1]
+            partial = [([], np.asarray(state0.memory[b]),
+                        np.asarray(state0.output[b]), 1.0)]
+            complete = []
+            for t in range(T):
+                expansions = []
+                for sent, mem, out, score in partial:
+                    st = type(state0)(
+                        memory=jnp.asarray(mem[None]),
+                        output=jnp.asarray(out[None]),
+                        recurrent=jnp.asarray(out[None]),
+                    )
+                    word_in = sent[-1] if sent else 0
+                    st2, logp = host_step(params, cfg, ctx_b, st, [word_in])
+                    probs = np.exp(logp[0])
+                    top = np.argsort(-probs)[: K + 1]
+                    for w in top:
+                        cand = (sent + [int(w)], np.asarray(st2.memory[0]),
+                                np.asarray(st2.output[0]), score * probs[w])
+                        if w == EOS:
+                            complete.append(cand)
+                        else:
+                            expansions.append(cand)
+                complete = sorted(complete, key=lambda x: -x[3])[:K]
+                partial = sorted(expansions, key=lambda x: -x[3])[:K]
+            pool = complete if complete else partial
+            ref_best.append(max(c[3] for c in pool))
+
+        res = beam_search(params, cfg, contexts, eos_id=EOS)
+        ours = np.exp(np.asarray(res.log_scores[:, 0], np.float64))
+        for b in range(B):
+            assert ours[b] >= ref_best[b] * (1 - 1e-4), (b, ours[b], ref_best[b])
+
+
+class TestFallback:
+    def test_no_completion_returns_partials(self):
+        """Suppress eos by giving it a huge negative embedding-path logit:
+        easier — just use an eos_id the model can't prefer and tiny T with
+        a vocab where eos never tops; verify lengths == T when nothing
+        finished."""
+        cfg, params, contexts = setup(seed=5)
+        # make eos catastrophically unlikely by biasing the decode layer
+        p2 = jax.tree_util.tree_map(lambda x: x, params)
+        bias = np.asarray(p2["decode"]["fc_2"]["bias"]).copy()
+        bias[EOS] = -1e9
+        p2["decode"]["fc_2"]["bias"] = jnp.asarray(bias)
+        res = beam_search(p2, cfg, contexts, eos_id=EOS)
+        T = cfg.max_caption_length
+        assert (np.asarray(res.lengths) == T).all()
+        assert (np.asarray(res.words) != EOS).all()
+        # scores sorted descending
+        s = np.asarray(res.log_scores)
+        assert (np.diff(s, axis=1) <= 1e-6).all()
+
+    def test_beam1_equals_greedy(self):
+        cfg, params, contexts = setup(seed=7)
+        r1 = beam_search(params, cfg, contexts, eos_id=EOS, beam_size=1)
+        r2 = greedy_decode(params, cfg, contexts, eos_id=EOS)
+        np.testing.assert_array_equal(np.asarray(r1.words), np.asarray(r2.words))
