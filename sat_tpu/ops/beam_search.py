@@ -38,6 +38,10 @@ from ..config import Config
 from ..models.decoder import DecoderState, decoder_step, init_state
 
 NEG_INF = -1e30
+# Added to completed-caption scores when ranking them against live partial
+# beams at the end of the search, so every completed caption outranks every
+# partial one (scores are log-probs of ≤20 tokens, far above -1e6).
+_FINISHED_RANK_BONUS = 1e6
 
 
 class BeamResult(NamedTuple):
@@ -142,17 +146,23 @@ def beam_search(
     (_, live_logp, live_words, live_len, _,
      fin_logp, fin_words, fin_len) = carry
 
-    # fall back to partial beams for images with zero completed captions
-    none_finished = (fin_logp <= NEG_INF / 2).all(axis=1, keepdims=True)  # [B,1]
-    out_logp = jnp.where(none_finished, live_logp, fin_logp)
-    out_words = jnp.where(none_finished[..., None], live_words, fin_words)
-    out_len = jnp.where(none_finished, live_len, fin_len)
-
-    order = jnp.argsort(-out_logp, axis=1)
+    # Merge: completed captions first (the reference only falls back to
+    # partials when NOTHING completed, base_model.py:236-237); any fin
+    # slots that never filled are backfilled per-slot from the live
+    # partial beams instead of surfacing -inf junk rows.
+    fin_valid = fin_logp > NEG_INF / 2
+    rank_key = jnp.concatenate(
+        [jnp.where(fin_valid, fin_logp + _FINISHED_RANK_BONUS, NEG_INF), live_logp],
+        axis=1,
+    )                                                       # [B,2K]
+    cand_logp = jnp.concatenate([fin_logp, live_logp], axis=1)
+    cand_words = jnp.concatenate([fin_words, live_words], axis=1)
+    cand_len = jnp.concatenate([fin_len, live_len], axis=1)
+    _, sel = jax.lax.top_k(rank_key, K)                     # [B,K]
     return BeamResult(
-        words=out_words[batch_idx, order],
-        log_scores=out_logp[batch_idx, order],
-        lengths=out_len[batch_idx, order],
+        words=cand_words[batch_idx, sel],
+        log_scores=cand_logp[batch_idx, sel],
+        lengths=cand_len[batch_idx, sel],
     )
 
 

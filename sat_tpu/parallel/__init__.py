@@ -1,0 +1,39 @@
+"""Distributed layer: device mesh, sharding rules, parallel train/eval steps.
+
+TPU-native replacement for the reference's TF1 ParameterServer strategy
+(/root/reference/clusterone_config.py:87-125, main_distributed.py:39-101):
+synchronous SPMD over a `jax.sharding.Mesh` instead of asynchronous gRPC
+parameter-server pulls.  Gradients all-reduce over ICI via XLA-inserted
+collectives; multi-host bootstrap wraps `jax.distributed.initialize`
+(the equivalent of the reference's TF_CONFIG/PS_HOSTS env plumbing).
+"""
+
+from .mesh import make_mesh, initialize_distributed, mesh_from_devices
+from .sharding import (
+    batch_sharding,
+    param_partition_specs,
+    replicated,
+    shard_batch,
+    shard_train_state,
+    train_state_shardings,
+)
+from .train import (
+    create_parallel_train_state,
+    make_parallel_beam_search,
+    make_parallel_train_step,
+)
+
+__all__ = [
+    "make_mesh",
+    "mesh_from_devices",
+    "initialize_distributed",
+    "batch_sharding",
+    "replicated",
+    "param_partition_specs",
+    "train_state_shardings",
+    "shard_batch",
+    "shard_train_state",
+    "make_parallel_train_step",
+    "create_parallel_train_state",
+    "make_parallel_beam_search",
+]

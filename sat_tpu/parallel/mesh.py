@@ -1,0 +1,101 @@
+"""Device mesh construction and multi-host bootstrap.
+
+The reference builds its cluster from PS_HOSTS/WORKER_HOSTS/JOB_NAME/
+TASK_INDEX env vars and starts one gRPC `tf.train.Server` per process
+(/root/reference/clusterone_config.py:39-61,106-114).  The TPU-native
+equivalent is a GSPMD device mesh: every process runs the SAME program,
+`jax.distributed.initialize` wires DCN coordination, and the `Mesh` lays
+the global device set out as named axes:
+
+* ``data``  — batch sharding; gradient psum rides ICI along this axis;
+* ``model`` — parameter sharding (vocab-dim embedding/softmax, the
+  TP-style axis SURVEY.md §2 calls for).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ..config import Config
+
+
+# Env vars whose presence signals a multi-process launch worth wiring up.
+_MULTIHOST_ENV_SIGNALS = (
+    "JAX_COORDINATOR_ADDRESS",      # explicit JAX bootstrap
+    "TPU_WORKER_HOSTNAMES",         # Cloud TPU pod slice
+    "MEGASCALE_COORDINATOR_ADDRESS",  # multi-slice DCN
+    "SLURM_STEP_NODELIST",          # SLURM launcher
+)
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Multi-host bootstrap (replaces the reference's tf.train.Server +
+    ClusterSpec plumbing, clusterone_config.py:106-114).
+
+    Call once per process BEFORE any other jax use.  Whether to wire a
+    cluster is decided purely from the arguments and launcher env vars —
+    never by querying the (not-yet-initialized) backend.  Returns True if
+    `jax.distributed.initialize` was invoked.  Plain single-host runs are
+    a no-op, mirroring the reference's single-machine fallback
+    (clusterone_config.py:91-93).
+    """
+    explicit = coordinator_address is not None or num_processes is not None
+    env_signal = any(os.environ.get(k) for k in _MULTIHOST_ENV_SIGNALS)
+    if not explicit and not env_signal:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def mesh_from_devices(
+    devices: Sequence[jax.Device],
+    shape: Tuple[int, ...],
+    axes: Tuple[str, ...],
+) -> Mesh:
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {n} devices, only {len(devices)} available"
+        )
+    return Mesh(np.asarray(devices[:n]).reshape(shape), axes)
+
+
+def make_mesh(config: Config, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the (data, model) mesh from config.mesh_shape.
+
+    ``mesh_shape=(0, m)`` means "all remaining devices on the data axis" —
+    the common case where a checked-in config runs unchanged on any slice
+    size (a deliberate upgrade over the reference's host-count env vars).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    shape = tuple(config.mesh_shape)
+    axes = tuple(config.mesh_axes)
+    if len(shape) != len(axes):
+        raise ValueError(f"mesh_shape {shape} / mesh_axes {axes} length mismatch")
+    if 0 in shape:
+        fixed = int(np.prod([s for s in shape if s != 0]))
+        if len([s for s in shape if s == 0]) != 1 or len(devices) % fixed:
+            raise ValueError(f"cannot infer mesh {shape} over {len(devices)} devices")
+        shape = tuple(len(devices) // fixed if s == 0 else s for s in shape)
+    return mesh_from_devices(devices, shape, axes)
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    return mesh.shape.get("data", 1)
+
+
+def model_axis_size(mesh: Mesh) -> int:
+    return mesh.shape.get("model", 1)

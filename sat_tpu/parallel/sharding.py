@@ -1,0 +1,103 @@
+"""Sharding rules: how the caption model's state lays out over the mesh.
+
+The reference's only placement policy is "Variables on ps, ops on workers"
+(/root/reference/clusterone_config.py:116-124).  Here placement is a pure
+function of array shape:
+
+* batch arrays shard dim 0 over ``data`` (SPMD data parallelism — the
+  synchronous upgrade of the reference's async PS strategy, §2.13);
+* any parameter dimension equal to ``vocabulary_size`` shards over
+  ``model`` — that covers the [V,E] embedding table and the [*,V] softmax
+  projection (+ their Adam moments, which share shapes), the TP axis the
+  5000-way softmax admits (SURVEY.md §2 parallelism checklist);
+* everything else is replicated.
+
+Because the rule keys on shapes it applies uniformly to params, optimizer
+slots and batch stats with one tree_map — no per-layer annotations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Config
+from ..train.step import TrainState
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard dim 0 (batch) over the data axis."""
+    return NamedSharding(mesh, P("data"))
+
+
+# Subtrees whose vocab-sized dims shard over 'model'.  Path-gated (not
+# shape-only) so an unrelated dim that happens to equal vocabulary_size —
+# e.g. 4*num_lstm_units in a small test config — never gets sharded.
+_VOCAB_SHARDED_SCOPES = ("word_embedding", "decode")
+
+
+def _path_keys(path):
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if key is None:
+            key = getattr(entry, "name", entry)
+        yield str(key)
+
+
+def _leaf_spec(path, shape, config: Config, model_size: int) -> P:
+    """Vocab-sized dims of embedding/softmax leaves → 'model'; else replicate.
+
+    Applies uniformly to params AND their mirrors (Adam moments inside
+    opt_state carry the same dict path suffix), so one rule places all.
+    """
+    if model_size > 1 and any(
+        key in _VOCAB_SHARDED_SCOPES for key in _path_keys(path)
+    ):
+        for i, d in enumerate(shape):
+            if d == config.vocabulary_size and d % model_size == 0:
+                dims = [None] * len(shape)
+                dims[i] = "model"
+                return P(*dims)
+    return P()
+
+
+def param_partition_specs(params: Any, config: Config, mesh: Mesh) -> Any:
+    """PartitionSpec pytree matching ``params`` (works for any pytree of
+    arrays/ShapeDtypeStructs: params, opt_state, batch_stats)."""
+    msize = mesh.shape.get("model", 1)
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x: _leaf_spec(p, np.shape(x), config, msize), params
+    )
+
+
+def train_state_shardings(state: TrainState, config: Config, mesh: Mesh) -> TrainState:
+    """NamedSharding pytree with TrainState structure.  ``state`` may be a
+    concrete TrainState or the jax.eval_shape abstraction of one."""
+    specs = jax.tree_util.tree_map_with_path(
+        lambda p, x: _leaf_spec(p, np.shape(x), config, mesh.shape.get("model", 1)),
+        state,
+    )
+    return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs)
+
+
+def shard_train_state(state: TrainState, config: Config, mesh: Mesh) -> TrainState:
+    """Place a host/replicated TrainState onto the mesh."""
+    return jax.device_put(state, train_state_shardings(state, config, mesh))
+
+
+def shard_batch(batch: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
+    """Place a global batch dict onto the mesh, dim 0 over 'data'.
+
+    Single-host path: arrays are host-global, device_put scatters them.
+    Multi-host: each process holds its LOCAL shard of the batch; use
+    ``make_global_batch`` (collectives.py) instead.
+    """
+    sh = batch_sharding(mesh)
+    return {k: jax.device_put(v, sh) for k, v in batch.items()}

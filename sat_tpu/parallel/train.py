@@ -1,0 +1,84 @@
+"""Mesh-parallel train and decode steps.
+
+One jitted program per step, exactly like the single-chip path
+(sat_tpu/train/step.py) — parallelism enters ONLY through shardings:
+the batch arrives split over 'data', vocab-dim parameters split over
+'model', and XLA compiles in the gradient all-reduce / softmax
+collectives.  This replaces the reference's asynchronous PS loop
+(/root/reference/main_distributed.py:57-79) with synchronous SPMD.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Config
+from ..models.captioner import encode
+from ..ops.beam_search import BeamResult, beam_search
+from ..train.step import TrainState, create_train_state, make_train_step
+from .sharding import (
+    batch_sharding,
+    replicated,
+    shard_train_state,
+    train_state_shardings,
+)
+
+
+def _abstract_state(config: Config) -> TrainState:
+    rng = jax.random.PRNGKey(0)
+    return jax.eval_shape(lambda r: create_train_state(r, config), rng)
+
+
+def create_parallel_train_state(
+    rng: jax.Array, config: Config, mesh: Mesh
+) -> TrainState:
+    """Initialize and place the train state onto the mesh."""
+    return shard_train_state(create_train_state(rng, config), config, mesh)
+
+
+def make_parallel_train_step(
+    config: Config, mesh: Mesh
+) -> Callable[[TrainState, Dict[str, Any], jax.Array], Tuple[TrainState, Dict[str, Any]]]:
+    """Jitted (state, batch, rng) -> (state, metrics) with mesh shardings.
+
+    Batch dim 0 must be divisible by the data-axis size; metrics come out
+    replicated (already globally reduced — the loss normalizes by the
+    GLOBAL mask sum, so no host-side averaging is needed)."""
+    state_sh = train_state_shardings(_abstract_state(config), config, mesh)
+    batch_sh = batch_sharding(mesh)
+    repl = replicated(mesh)
+
+    return jax.jit(
+        make_train_step(config),
+        in_shardings=(state_sh, batch_sh, repl),
+        out_shardings=(state_sh, repl),
+        donate_argnums=(0,),
+    )
+
+
+def make_parallel_beam_search(
+    config: Config,
+    mesh: Mesh,
+    eos_id: int,
+    beam_size: Optional[int] = None,
+) -> Callable[[Dict[str, Any], Any], BeamResult]:
+    """Jitted (variables, images) -> BeamResult, batch sharded over 'data'.
+
+    Encoder + full on-device beam search in one program; every data-mesh
+    row decodes its image shard, results come back batch-sharded."""
+    K = beam_size or config.beam_size
+
+    def caption(variables: Dict[str, Any], images) -> BeamResult:
+        contexts, _ = encode(variables, config, images, train=False)
+        return beam_search(
+            variables["params"]["decoder"], config, contexts, eos_id, beam_size=K
+        )
+
+    return jax.jit(
+        caption,
+        in_shardings=(None, batch_sharding(mesh)),
+        out_shardings=batch_sharding(mesh),
+    )

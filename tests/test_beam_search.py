@@ -237,6 +237,25 @@ class TestFallback:
         s = np.asarray(res.log_scores)
         assert (np.diff(s, axis=1) <= 1e-6).all()
 
+    def test_partial_slots_backfilled_with_live_beams(self):
+        """Images with 1..K-1 completions must not surface -inf junk rows:
+        unfilled slots come from the live partial beams."""
+        cfg, params, contexts = setup(seed=3)
+        bias = np.asarray(params["decode"]["fc_2"]["bias"]).copy()
+        bias[EOS] += 1.5  # some but rarely K completions per image
+        params["decode"]["fc_2"]["bias"] = jnp.asarray(bias)
+        res = beam_search(params, cfg, contexts, eos_id=EOS)
+        s = np.asarray(res.log_scores)
+        assert (s > -1e15).all(), "junk sentinel rows leaked into results"
+        words = np.asarray(res.words)
+        lengths = np.asarray(res.lengths)
+        T = cfg.max_caption_length
+        for b in range(words.shape[0]):
+            for k in range(cfg.beam_size):
+                finished = EOS in words[b, k]
+                # a backfilled partial is a full-length eos-free rollout
+                assert finished or lengths[b, k] == T
+
     def test_beam1_equals_greedy(self):
         cfg, params, contexts = setup(seed=7)
         r1 = beam_search(params, cfg, contexts, eos_id=EOS, beam_size=1)
