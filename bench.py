@@ -1,0 +1,93 @@
+"""Benchmark: training throughput of the flagship caption model.
+
+Measures steady-state captions/sec of the jitted train step — VGG16
+encoder forward (frozen CNN, the reference's published configuration,
+/root/reference/config.py:8-43 + README.md:85-89), 20-step scan decoder,
+backward, global-norm clip 5.0, Adam — on whatever single device JAX
+provides (the driver runs this on one real TPU chip).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no throughput numbers (SURVEY.md §6), so
+``vs_baseline`` is computed against ``published.train_captions_per_sec``
+in BASELINE.json when present (recorded from a prior round), else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sat_tpu.config import Config
+    from sat_tpu.train.step import create_train_state, make_jit_train_step
+
+    config = Config(batch_size=64)
+    B, T = config.batch_size, config.max_caption_length
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "images": jnp.asarray(rng.normal(size=(B, 224, 224, 3)).astype(np.float32)),
+        "word_idxs": jnp.asarray(
+            rng.integers(0, config.vocabulary_size, size=(B, T)).astype(np.int32)
+        ),
+        "masks": jnp.asarray(
+            (np.arange(T)[None, :] < rng.integers(8, T + 1, size=(B, 1))).astype(
+                np.float32
+            )
+        ),
+    }
+
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    train_step = make_jit_train_step(config)
+    step_rng = jax.random.PRNGKey(1)
+
+    # Sync barrier: fetch a scalar to host.  (block_until_ready alone does
+    # not actually block on tunneled device platforms.)
+    def sync(metrics):
+        return float(metrics["total_loss"])
+
+    # compile + settle
+    for _ in range(2):
+        state, metrics = train_step(state, batch, step_rng)
+    sync(metrics)
+
+    n_steps = int(os.environ.get("BENCH_STEPS", "20"))
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = train_step(state, batch, step_rng)
+    sync(metrics)
+    elapsed = time.perf_counter() - t0
+
+    captions_per_sec = n_steps * B / elapsed
+
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+            baseline = json.load(f).get("published", {}).get("train_captions_per_sec")
+    except (OSError, json.JSONDecodeError):
+        pass
+    vs_baseline = captions_per_sec / baseline if baseline else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_captions_per_sec",
+                "value": round(captions_per_sec, 2),
+                "unit": "captions/sec/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -99,6 +99,7 @@ class Config:
     test_result_file: str = "./data/test/results.csv"
 
     # ---- TPU-native knobs (no reference equivalent) ----
+    image_size: int = 224              # square input edge; 224 = reference
     compute_dtype: str = "bfloat16"    # MXU-friendly matmul/conv dtype
     param_dtype: str = "float32"       # master params stay fp32
     mesh_shape: Tuple[int, ...] = (1, 1)   # (data, model) device mesh
@@ -145,8 +146,13 @@ class Config:
 
     @property
     def num_ctx(self) -> int:
-        """Spatial context-grid size (reference model.py:58,107)."""
-        return 196 if self.cnn == "vgg16" else 49
+        """Spatial context-grid size (reference model.py:58,107): 196 for
+        VGG16 / 49 for ResNet50 at the reference's 224×224 input; scales
+        with image_size (VGG16 downsamples 16×, ResNet50 32×)."""
+        stride = 16 if self.cnn == "vgg16" else 32
+        # SAME-padded convs/pools round spatial dims UP at each stage, so
+        # the composed downsampling is ceil division.
+        return (-(-self.image_size // stride)) ** 2
 
     @property
     def dim_ctx(self) -> int:

@@ -38,7 +38,7 @@ def init_variables(rng: jax.Array, config: Config) -> Dict[str, Any]:
     """Initialize all model variables with dummy image input."""
     k_cnn, k_dec = jax.random.split(rng)
     encoder = make_encoder(config)
-    dummy = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    dummy = jnp.zeros((1, config.image_size, config.image_size, 3), jnp.float32)
     cnn_vars = encoder.init(k_cnn, dummy, train=False)
     out = {
         "params": {

@@ -25,7 +25,6 @@ import jax.numpy as jnp
 
 from ..nn.layers import Conv, max_pool2d
 
-NUM_CTX = 49
 DIM_CTX = 2048
 
 
@@ -134,4 +133,6 @@ class ResNet50(nn.Module):
                 )(x)
 
         b = x.shape[0]
-        return x.reshape(b, NUM_CTX, DIM_CTX).astype(jnp.float32)
+        # 49 contexts at the reference's 224×224 input (model.py:103-108);
+        # -1 keeps the module usable at other static image sizes.
+        return x.reshape(b, -1, DIM_CTX).astype(jnp.float32)

@@ -28,7 +28,6 @@ _VGG_LAYERS = [
     ("conv5_1", 512, False), ("conv5_2", 512, False), ("conv5_3", 512, False),
 ]
 
-NUM_CTX = 196
 DIM_CTX = 512
 
 
@@ -50,4 +49,6 @@ class VGG16(nn.Module):
             if pool_after:
                 x = max_pool2d(x)
         b = x.shape[0]
-        return x.reshape(b, NUM_CTX, DIM_CTX).astype(jnp.float32)
+        # 196 contexts at the reference's 224×224 input (model.py:54-59);
+        # -1 keeps the module usable at other static image sizes.
+        return x.reshape(b, -1, DIM_CTX).astype(jnp.float32)

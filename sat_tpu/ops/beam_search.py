@@ -45,7 +45,10 @@ _FINISHED_RANK_BONUS = 1e6
 
 
 class BeamResult(NamedTuple):
-    """Sorted best-first per image."""
+    """Per-image captions ranked finished-first (reference semantics:
+    completed captions beat live partials, base_model.py:236-237), then by
+    descending score within each group — so log_scores is NOT globally
+    monotonic when a weak completed caption outranks a strong partial."""
 
     words: jnp.ndarray      # [B, K, T] int32 token ids ('.'-terminated)
     log_scores: jnp.ndarray  # [B, K] sum of log p(word) — product ordering

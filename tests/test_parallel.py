@@ -95,8 +95,9 @@ def test_parallel_train_step_matches_single_device():
 
     for k in m_single:
         np.testing.assert_allclose(
-            np.asarray(m_single[k]), np.asarray(m_par[k]), rtol=2e-4, atol=2e-5
-        ), k
+            np.asarray(m_single[k]), np.asarray(m_par[k]), rtol=2e-4, atol=2e-5,
+            err_msg=k,
+        )
     # a second step runs (donation + resharding are stable)
     pstate, _ = pstep(pstate, shard_batch(context_batch(config, seed=1), mesh), drop)
     assert int(pstate.step) == 2
