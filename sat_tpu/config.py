@@ -121,9 +121,11 @@ class Config:
         return dataclasses.asdict(self)
 
     def save(self, path: str) -> None:
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(self.to_dict(), f, indent=2, default=list)
+        from .utils.fileio import atomic_write
+
+        atomic_write(
+            path, "w", lambda f: json.dump(self.to_dict(), f, indent=2, default=list)
+        )
 
     @classmethod
     def load(cls, path: str) -> "Config":

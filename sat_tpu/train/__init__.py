@@ -1,3 +1,10 @@
+from .checkpoint import (
+    latest_checkpoint,
+    load_pretrained_cnn,
+    restore_checkpoint,
+    save_checkpoint,
+    trim_checkpoint,
+)
 from .optimizer import make_learning_rate, make_optimizer
 from .step import (
     TrainState,
@@ -17,4 +24,9 @@ __all__ = [
     "make_learning_rate",
     "make_optimizer",
     "split_trainable",
+    "latest_checkpoint",
+    "load_pretrained_cnn",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "trim_checkpoint",
 ]

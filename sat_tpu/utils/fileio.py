@@ -1,0 +1,37 @@
+"""Host-side file I/O helpers.
+
+The reference writes checkpoints and configs with plain ``np.save`` /
+``pickle.dump`` (/root/reference/base_model.py:248-253), so a preempted
+process can leave torn files.  Every durable artifact in this framework
+goes through ``atomic_write`` instead: tmp file + rename, with the final
+mode honoring the process umask (mkstemp alone would leave 0600 files
+other readers of a shared filesystem can't open).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, IO
+
+
+def atomic_write(path: str, mode: str, writer: Callable[[IO], None]) -> None:
+    """Write ``path`` atomically: ``writer(f)`` into a tmp file in the same
+    directory, fchmod to umask-derived permissions, then ``os.replace``.
+
+    ``mode`` is 'w' (text) or 'wb' (binary).
+    """
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        umask = os.umask(0)
+        os.umask(umask)
+        os.fchmod(fd, 0o666 & ~umask)
+        with os.fdopen(fd, mode) as f:
+            writer(f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise

@@ -1,0 +1,210 @@
+"""Checkpoint save/restore/import/trim (SURVEY.md §2.12, §2.29, §3.5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sat_tpu.config import Config
+from sat_tpu.models.captioner import init_variables
+from sat_tpu.train.checkpoint import (
+    latest_checkpoint,
+    load_flat,
+    load_pretrained_cnn,
+    restore_checkpoint,
+    save_checkpoint,
+    state_to_flat,
+    trim_checkpoint,
+)
+from sat_tpu.train.step import create_train_state, make_jit_train_step
+
+
+TINY = dict(
+    image_size=32,
+    vocabulary_size=50,
+    dim_embedding=8,
+    num_lstm_units=8,
+    dim_initialize_layer=8,
+    dim_attend_layer=8,
+    dim_decode_layer=16,
+    max_caption_length=5,
+    compute_dtype="float32",
+)
+
+
+def _tiny_config(**kw):
+    return Config(**{**TINY, **kw})
+
+
+def _batch(config, rng, B=2):
+    T = config.max_caption_length
+    return {
+        "images": jnp.asarray(
+            rng.normal(size=(B, config.image_size, config.image_size, 3)).astype(
+                np.float32
+            )
+        ),
+        "word_idxs": jnp.asarray(
+            rng.integers(0, config.vocabulary_size, size=(B, T)).astype(np.int32)
+        ),
+        "masks": jnp.ones((B, T), jnp.float32),
+    }
+
+
+def test_save_restore_roundtrip(tmp_path, rng):
+    config = _tiny_config(save_dir=str(tmp_path))
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    step = make_jit_train_step(config)
+    state, _ = step(state, _batch(config, rng), jax.random.PRNGKey(1))
+
+    path = save_checkpoint(state, config)
+    assert path.endswith("1.npz")
+    assert latest_checkpoint(str(tmp_path)) == path
+
+    fresh = create_train_state(jax.random.PRNGKey(7), config)
+    restored, count = restore_checkpoint(fresh, save_dir=str(tmp_path))
+    assert count > 0
+    assert int(restored.step) == 1
+
+    want = state_to_flat(state)
+    got = state_to_flat(restored)
+    assert set(want) == set(got)
+    for k in want:
+        np.testing.assert_allclose(want[k], got[k], err_msg=k)
+
+    # restored state must keep training (optimizer slots intact)
+    restored2, _ = step(restored, _batch(config, rng), jax.random.PRNGKey(2))
+    assert int(restored2.step) == 2
+
+
+def test_restore_latest_picks_newest(tmp_path, rng):
+    config = _tiny_config(save_dir=str(tmp_path))
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    step = make_jit_train_step(config)
+    save_checkpoint(state, config)                     # 0.npz
+    state, _ = step(state, _batch(config, rng), jax.random.PRNGKey(1))
+    save_checkpoint(state, config)                     # 1.npz
+    assert latest_checkpoint(str(tmp_path)).endswith("1.npz")
+
+
+def test_trimmed_checkpoint_partial_restores(tmp_path, rng):
+    """Trim drops optimizer slots; the slim file still restores params —
+    the reference's trim_model.py + tolerant load path."""
+    config = _tiny_config(save_dir=str(tmp_path))
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    step = make_jit_train_step(config)
+    state, _ = step(state, _batch(config, rng), jax.random.PRNGKey(1))
+    path = save_checkpoint(state, config)
+
+    slim = str(tmp_path / "slim.npz")
+    kept = trim_checkpoint(path, slim)
+    flat = load_flat(slim)
+    assert kept == len(flat)
+    assert not any(k.startswith("optimizer/") for k in flat)
+    assert any(k.startswith("params/") for k in flat)
+
+    fresh = create_train_state(jax.random.PRNGKey(9), config)
+    restored, count = restore_checkpoint(fresh, model_file=slim)
+    assert count > 0
+    want = state_to_flat(state)
+    got = state_to_flat(restored)
+    for k in want:
+        if k.startswith("params/") or k == "global_step":
+            np.testing.assert_allclose(want[k], got[k], err_msg=k)
+
+
+@pytest.mark.parametrize("cnn", ["vgg16", "resnet50"])
+def test_pretrained_cnn_import(tmp_path, cnn):
+    """Nested {op: {param: arr}} npy import — the reference's
+    vgg16_no_fc.npy / resnet50_no_fc.npy format (base_model.py:280-297)."""
+    config = _tiny_config(cnn=cnn, image_size=64)
+    variables = init_variables(jax.random.PRNGKey(0), config)
+
+    if cnn == "vgg16":
+        kshape = tuple(variables["params"]["cnn"]["conv1_1"]["conv"]["kernel"].shape)
+        nested = {
+            "conv1_1": {
+                "weights": np.full(kshape, 0.5, np.float32),
+                "biases": np.full((kshape[-1],), 0.25, np.float32),
+            },
+            "not_a_layer": {"weights": np.zeros((3, 3, 1, 1), np.float32)},
+        }
+        want_loaded = 2
+    else:
+        k1 = tuple(variables["params"]["cnn"]["conv1"]["conv"]["kernel"].shape)
+        k2 = tuple(
+            variables["params"]["cnn"]["res2a"]["res2a_branch2a"]["conv"]["kernel"].shape
+        )
+        c = k1[-1]
+        nested = {
+            "conv1": {"weights": np.full(k1, 0.5, np.float32)},
+            "bn_conv1": {
+                "scale": np.full((c,), 2.0, np.float32),
+                "offset": np.full((c,), 0.1, np.float32),
+                "mean": np.full((c,), 0.3, np.float32),
+                "variance": np.full((c,), 0.9, np.float32),
+            },
+            "res2a_branch2a": {"weights": np.full(k2, 0.25, np.float32)},
+        }
+        want_loaded = 6
+
+    path = str(tmp_path / f"{cnn}_no_fc.npy")
+    np.save(path, np.array(nested, dtype=object), allow_pickle=True)
+
+    new_vars, count = load_pretrained_cnn(variables, path)
+    assert count == want_loaded
+
+    if cnn == "vgg16":
+        np.testing.assert_allclose(
+            np.asarray(new_vars["params"]["cnn"]["conv1_1"]["conv"]["kernel"]), 0.5
+        )
+        np.testing.assert_allclose(
+            np.asarray(new_vars["params"]["cnn"]["conv1_1"]["conv"]["bias"]), 0.25
+        )
+    else:
+        np.testing.assert_allclose(
+            np.asarray(new_vars["params"]["cnn"]["bn_conv1"]["scale"]), 2.0
+        )
+        np.testing.assert_allclose(
+            np.asarray(new_vars["batch_stats"]["bn_conv1"]["mean"]), 0.3
+        )
+        np.testing.assert_allclose(
+            np.asarray(
+                new_vars["params"]["cnn"]["res2a"]["res2a_branch2a"]["conv"]["kernel"]
+            ),
+            0.25,
+        )
+
+
+def test_torn_config_json_falls_back_to_scan(tmp_path, rng):
+    config = _tiny_config(save_dir=str(tmp_path))
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    path = save_checkpoint(state, config)
+    with open(tmp_path / "config.json", "w") as f:
+        f.write('{"phase": "tr')  # torn mid-write
+    assert latest_checkpoint(str(tmp_path)) == path
+
+
+def test_global_step_alone_is_not_a_restore(tmp_path, rng):
+    """count==0 must mean 'no tensors restored' — the always-present
+    global_step entry may not inflate the count."""
+    np.savez(tmp_path / "7.npz", global_step=np.asarray(7, np.int32))
+
+    config = _tiny_config(save_dir=str(tmp_path))
+    fresh = create_train_state(jax.random.PRNGKey(1), config)
+    restored, count = restore_checkpoint(fresh, model_file=str(tmp_path / "7.npz"))
+    assert count == 0
+    assert int(restored.step) == 7
+
+
+def test_stale_config_pointer_does_not_shadow_newer_checkpoint(tmp_path, rng):
+    """Preemption between the npz rename and the config.json update must
+    not lose the newest checkpoint."""
+    config = _tiny_config(save_dir=str(tmp_path))
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    step = make_jit_train_step(config)
+    save_checkpoint(state, config)                     # 0.npz + pointer→0
+    state, _ = step(state, _batch(config, rng), jax.random.PRNGKey(1))
+    save_checkpoint(state, config)                     # 1.npz + pointer→1
+    config.replace(global_step=0).save(str(tmp_path / "config.json"))  # stale
+    assert latest_checkpoint(str(tmp_path)).endswith("1.npz")
