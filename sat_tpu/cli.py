@@ -1,0 +1,127 @@
+"""Command-line driver: ``python -m sat_tpu.cli --phase=train|eval|test``.
+
+Flag-for-flag parity with the reference CLI (/root/reference/main.py:15-36):
+``--phase --load --model_file --load_cnn --cnn_model_file --train_cnn
+--beam_size``, dispatching to the runtime layer (main.py:45-72).  Any other
+Config field can be overridden with ``--set key=value`` pairs (the
+reference requires editing config.py for those).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from typing import List, Optional
+
+from .config import Config
+
+
+def _parse_override(config: Config, key: str, raw: str):
+    fields = {f.name: f for f in dataclasses.fields(Config)}
+    if key not in fields:
+        raise SystemExit(f"--set {key}: unknown Config field")
+    current = getattr(config, key)
+    if raw.lower() == "none":  # Optional[int] caps: 'none' clears the cap
+        return None
+    if isinstance(current, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(current, int):
+        return int(raw)
+    if isinstance(current, float):
+        return float(raw)
+    if isinstance(current, tuple):
+        return tuple(int(x) for x in raw.split(","))
+    if current is None:  # field currently None: best-effort int, else str
+        try:
+            return int(raw)
+        except ValueError:
+            return raw
+    return raw
+
+
+def build_config(argv: Optional[List[str]] = None):
+    """Returns (Config, cli_options_dict)."""
+    p = argparse.ArgumentParser(
+        prog="sat_tpu",
+        description="TPU-native Show, Attend and Tell",
+    )
+    p.add_argument("--phase", default="train", choices=["train", "eval", "test"])
+    p.add_argument(
+        "--load", action="store_true",
+        help="resume from the latest checkpoint in save_dir",
+    )
+    p.add_argument("--model_file", default=None, help="explicit checkpoint file")
+    p.add_argument(
+        "--load_cnn", action="store_true",
+        help="import a pretrained CNN before training",
+    )
+    p.add_argument(
+        "--cnn_model_file", default="./vgg16_no_fc.npy",
+        help="pretrained CNN npy (reference nested format)",
+    )
+    p.add_argument(
+        "--train_cnn", action="store_true",
+        help="jointly train CNN + RNN (default: RNN only)",
+    )
+    p.add_argument("--beam_size", type=int, default=3)
+    p.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VALUE",
+        help="override any Config field, repeatable",
+    )
+    args = p.parse_args(argv)
+
+    config = Config(
+        phase=args.phase,
+        train_cnn=args.train_cnn,
+        beam_size=args.beam_size,
+    )
+    overrides = {}
+    for item in args.set:
+        if "=" not in item:
+            raise SystemExit(f"--set expects KEY=VALUE, got {item!r}")
+        key, raw = item.split("=", 1)
+        overrides[key] = _parse_override(config, key, raw)
+    if overrides:
+        config = config.replace(**overrides)
+
+    cli = {
+        "load": args.load,
+        "model_file": args.model_file,
+        "load_cnn": args.load_cnn,
+        "cnn_model_file": args.cnn_model_file,
+    }
+    return config, cli
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    config, cli = build_config(argv)
+
+    from . import runtime
+
+    if config.phase == "train":
+        state = runtime.setup_state(
+            config,
+            load=cli["load"],
+            model_file=cli["model_file"],
+            load_cnn=cli["load_cnn"],
+            cnn_model_file=cli["cnn_model_file"],
+        )
+        runtime.train(config, state=state)
+    elif config.phase == "eval":
+        state = runtime.setup_state(
+            config, load=True, model_file=cli["model_file"]
+        )
+        scores = runtime.evaluate(config, state=state)
+        for k, v in scores.items():
+            print(f"{k}: {v:.4f}")
+    else:
+        state = runtime.setup_state(
+            config, load=True, model_file=cli["model_file"]
+        )
+        runtime.test(config, state=state)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,11 +62,17 @@ def beam_search(
     eos_id: int,
     beam_size: Optional[int] = None,
     max_len: Optional[int] = None,
+    valid_size: Optional[int] = None,
 ) -> BeamResult:
     """Decode captions for a batch of context grids.
 
     contexts: [B, N, D] float32 (encoder output).
     eos_id: vocabulary index of the '.' terminator token.
+    valid_size: number of real vocabulary entries; logit columns beyond it
+      are masked out.  The model's logit width is config.vocabulary_size,
+      but a vocabulary built from a small corpus shrinks below that
+      (reference vocabulary.py:25-26), leaving trailing logit columns with
+      no word — the reference would index past its word list there.
     """
     K = beam_size or config.beam_size
     T = max_len or config.max_caption_length
@@ -100,6 +106,8 @@ def beam_search(
         new_state, logits, _ = decoder_step(
             params, config, ctx_tiled, state, last_word.reshape(B * K), train=False
         )
+        if valid_size is not None and valid_size < V:
+            logits = logits.at[:, valid_size:].set(NEG_INF)
         step_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         step_logp = step_logp.reshape(B, K, V)
         logp = step_logp + live_logp[..., None]               # [B,K,V] cumulative
@@ -169,9 +177,16 @@ def beam_search(
     )
 
 
-@partial(jax.jit, static_argnames=("config", "eos_id", "beam_size", "max_len"))
-def beam_search_jit(params, config, contexts, eos_id, beam_size=None, max_len=None):
-    return beam_search(params, config, contexts, eos_id, beam_size, max_len)
+@partial(
+    jax.jit,
+    static_argnames=("config", "eos_id", "beam_size", "max_len", "valid_size"),
+)
+def beam_search_jit(
+    params, config, contexts, eos_id, beam_size=None, max_len=None, valid_size=None
+):
+    return beam_search(
+        params, config, contexts, eos_id, beam_size, max_len, valid_size
+    )
 
 
 def greedy_decode(
@@ -180,6 +195,10 @@ def greedy_decode(
     contexts: jnp.ndarray,
     eos_id: int,
     max_len: Optional[int] = None,
+    valid_size: Optional[int] = None,
 ) -> BeamResult:
     """Argmax decoding — the degenerate beam=1 case."""
-    return beam_search(params, config, contexts, eos_id, beam_size=1, max_len=max_len)
+    return beam_search(
+        params, config, contexts, eos_id,
+        beam_size=1, max_len=max_len, valid_size=valid_size,
+    )

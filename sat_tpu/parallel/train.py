@@ -64,17 +64,22 @@ def make_parallel_beam_search(
     mesh: Mesh,
     eos_id: int,
     beam_size: Optional[int] = None,
+    valid_size: Optional[int] = None,
 ) -> Callable[[Dict[str, Any], Any], BeamResult]:
     """Jitted (variables, images) -> BeamResult, batch sharded over 'data'.
 
     Encoder + full on-device beam search in one program; every data-mesh
-    row decodes its image shard, results come back batch-sharded."""
+    row decodes its image shard, results come back batch-sharded.
+    valid_size: real vocabulary entry count (see ops.beam_search) — pass
+    len(vocabulary.words) whenever the vocabulary may have shrunk below
+    config.vocabulary_size."""
     K = beam_size or config.beam_size
 
     def caption(variables: Dict[str, Any], images) -> BeamResult:
         contexts, _ = encode(variables, config, images, train=False)
         return beam_search(
-            variables["params"]["decoder"], config, contexts, eos_id, beam_size=K
+            variables["params"]["decoder"], config, contexts, eos_id,
+            beam_size=K, valid_size=valid_size,
         )
 
     return jax.jit(

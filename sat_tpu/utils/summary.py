@@ -1,0 +1,186 @@
+"""Training observability: TensorBoard-compatible event files + JSONL.
+
+The reference writes TF summaries every step — scalar losses/accuracy,
+per-trainable-variable mean/std/min/max/histogram, and attention-map stats
+(/root/reference/model.py:515-543, written at base_model.py:46-47,63).
+
+This module reproduces that capability with zero TensorFlow: a
+``SummaryWriter`` that emits the TFRecord/Event wire format directly
+(varint-encoded protobuf + masked CRC32C framing), so standard TensorBoard
+reads our logs, and mirrors every scalar into a ``metrics.jsonl`` for
+dependency-free analysis.  Histograms are replaced by mean/std/min/max
+scalar families (same diagnostic signal, no histo proto).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+from typing import Dict, Mapping, Optional
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli) — TFRecord framing requires it; stdlib zlib.crc32 is
+# the wrong polynomial.  Table-driven, reflected, poly 0x82F63B78.
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _CRC_TABLE.append(_c)
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Minimal protobuf wire encoding for tensorboard Event/Summary messages.
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _field_len(field: int, payload: bytes) -> bytes:
+    return _varint(field << 3 | 2) + _varint(len(payload)) + payload
+
+
+def _encode_value(tag: str, value: float) -> bytes:
+    # Summary.Value { string tag = 1; float simple_value = 2; }
+    return _field_len(1, tag.encode("utf-8")) + b"\x15" + struct.pack(
+        "<f", float(value)
+    )
+
+
+def _encode_event(
+    wall_time: float,
+    step: int,
+    scalars: Optional[Mapping[str, float]] = None,
+    file_version: Optional[str] = None,
+) -> bytes:
+    # Event { double wall_time = 1; int64 step = 2;
+    #         string file_version = 3; Summary summary = 5; }
+    out = b"\x09" + struct.pack("<d", wall_time) + b"\x10" + _varint(int(step))
+    if file_version is not None:
+        out += _field_len(3, file_version.encode("utf-8"))
+    if scalars:
+        summary = b"".join(
+            _field_len(1, _encode_value(tag, v)) for tag, v in scalars.items()
+        )
+        out += _field_len(5, summary)
+    return out
+
+
+def _frame_record(payload: bytes) -> bytes:
+    header = struct.pack("<Q", len(payload))
+    return (
+        header
+        + struct.pack("<I", _masked_crc(header))
+        + payload
+        + struct.pack("<I", _masked_crc(payload))
+    )
+
+
+# ---------------------------------------------------------------------------
+# Writer
+# ---------------------------------------------------------------------------
+
+
+class SummaryWriter:
+    """Writes ``events.out.tfevents.<ts>.<host>`` + ``metrics.jsonl`` under
+    ``log_dir``.  Usage: ``writer.scalars(step, {...})`` per step, plus
+    ``writer.variable_stats(step, params)`` for the per-variable summaries
+    the reference logs (model.py:527-535)."""
+
+    def __init__(self, log_dir: str, filename_suffix: str = ""):
+        os.makedirs(log_dir, exist_ok=True)
+        ts = int(time.time())
+        host = os.uname().nodename if hasattr(os, "uname") else "host"
+        self._event_path = os.path.join(
+            log_dir, f"events.out.tfevents.{ts}.{host}{filename_suffix}"
+        )
+        self._jsonl_path = os.path.join(log_dir, "metrics.jsonl")
+        self._events = open(self._event_path, "ab")
+        self._jsonl = open(self._jsonl_path, "a")
+        self._events.write(
+            _frame_record(
+                _encode_event(time.time(), 0, file_version="brain.Event:2")
+            )
+        )
+
+    def scalars(self, step: int, values: Mapping[str, float]) -> None:
+        clean: Dict[str, float] = {}
+        for tag, v in values.items():
+            v = float(np.asarray(v))
+            if np.isfinite(v):
+                clean[tag] = v
+        if not clean:
+            return
+        self._events.write(_frame_record(_encode_event(time.time(), step, clean)))
+        self._jsonl.write(json.dumps({"step": int(step), **clean}) + "\n")
+
+    def variable_stats(
+        self, step: int, tree, prefix: str = "params", max_vars: int = 0
+    ) -> None:
+        """Per-variable mean/std/min/max scalars — the reference's
+        variable_summary for every trainable (model.py:516-524).  Arrays
+        are reduced on device before the host transfer."""
+        import jax
+        import jax.numpy as jnp
+
+        stats = {}
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        if max_vars:
+            leaves = leaves[:max_vars]
+
+        @jax.jit
+        def reduce_all(leaf_list):
+            return [
+                (jnp.mean(x), jnp.std(x), jnp.min(x), jnp.max(x)) for x in leaf_list
+            ]
+
+        arrays = [leaf for _, leaf in leaves]
+        reduced = jax.device_get(reduce_all(arrays))
+        for (path, _), (mean, std, lo, hi) in zip(leaves, reduced):
+            name = prefix + "/" + "/".join(str(getattr(e, "key", getattr(e, "name", e))) for e in path)
+            stats[f"{name}/mean"] = mean
+            stats[f"{name}/std"] = std
+            stats[f"{name}/min"] = lo
+            stats[f"{name}/max"] = hi
+        self.scalars(step, stats)
+
+    def flush(self) -> None:
+        self._events.flush()
+        self._jsonl.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self._events.close()
+        self._jsonl.close()
+
+    def __enter__(self) -> "SummaryWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

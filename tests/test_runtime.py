@@ -1,0 +1,175 @@
+"""End-to-end runtime tests at fixture scale (SURVEY.md §2.10-2.11, §4)."""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from sat_tpu.cli import build_config
+from sat_tpu import runtime
+from sat_tpu.train.checkpoint import latest_checkpoint
+from sat_tpu.utils.summary import SummaryWriter, _masked_crc
+
+
+SMALL_MODEL = dict(
+    image_size=32,
+    dim_embedding=16,
+    num_lstm_units=16,
+    dim_initialize_layer=16,
+    dim_attend_layer=16,
+    dim_decode_layer=32,
+    compute_dtype="float32",
+    save_period=3,
+    log_every=1,
+    num_epochs=1,
+    num_data_workers=2,
+)
+
+
+@pytest.fixture(scope="module")
+def trained(coco_fixture):
+    """Train one epoch on the fixture; shared by eval/test phases below."""
+    config = coco_fixture["config"].replace(**SMALL_MODEL)
+    state = runtime.train(config)
+    return config, state
+
+
+def test_train_loop_end_to_end(trained):
+    config, state = trained
+    # 24 anns / batch 4 = 6 steps
+    assert int(state.step) == 6
+    ckpt = latest_checkpoint(config.save_dir)
+    assert ckpt is not None and ckpt.endswith("6.npz")
+    # summaries: jsonl rows with finite losses at every step
+    rows = [
+        json.loads(line)
+        for line in open(os.path.join(config.summary_dir, "metrics.jsonl"))
+    ]
+    assert [r["step"] for r in rows] == list(range(1, 7))
+    for r in rows:
+        assert np.isfinite(r["total_loss"])
+        assert np.isfinite(r["cross_entropy_loss"])
+    # tfevents file exists and is non-trivial
+    events = [
+        f for f in os.listdir(config.summary_dir) if f.startswith("events.out")
+    ]
+    assert events
+
+
+def test_eval_end_to_end(trained):
+    config, state = trained
+    scores = runtime.evaluate(config, state=state)
+    for key in ("Bleu_1", "Bleu_4", "METEOR", "ROUGE_L", "CIDEr"):
+        assert key in scores
+        assert 0.0 <= scores[key] <= 1.0 or key == "CIDEr" and scores[key] >= 0
+    # results.json written, one entry per unique eval image, valid captions
+    results = json.load(open(config.eval_result_file))
+    ids = [r["image_id"] for r in results]
+    assert len(ids) == len(set(ids)) > 0
+    for r in results:
+        assert r["caption"].endswith(".")
+
+
+def test_test_end_to_end(trained):
+    config, state = trained
+    results = runtime.test(config, state=state)
+    assert len(results) == 12                      # all fixture images
+    import pandas as pd
+
+    df = pd.read_csv(config.test_result_file)
+    assert list(df["caption"]) == [r["caption"] for r in results]
+    # a captioned JPG per input image
+    rendered = [f for f in os.listdir(config.test_result_dir) if f.endswith(".jpg")]
+    assert len(rendered) == 12
+
+
+def test_restore_0_tensors_is_an_error(coco_fixture, tmp_path):
+    config = coco_fixture["config"].replace(
+        **SMALL_MODEL, save_dir=str(tmp_path / "empty")
+    )
+    np.savez(
+        tmp_path / "empty_ckpt.npz", global_step=np.asarray(3, np.int32)
+    )
+    with pytest.raises(ValueError, match="0 tensors"):
+        runtime.setup_state(
+            config, load=True, model_file=str(tmp_path / "empty_ckpt.npz")
+        )
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_flag_parity():
+    config, cli = build_config(
+        ["--phase=eval", "--beam_size=5", "--train_cnn", "--load",
+         "--model_file=/x/y.npz", "--set", "batch_size=7",
+         "--set", "max_train_ann_num=none", "--set", "compute_dtype=float32"]
+    )
+    assert config.phase == "eval"
+    assert config.beam_size == 5
+    assert config.train_cnn is True
+    assert config.batch_size == 7
+    assert config.max_train_ann_num is None
+    assert config.compute_dtype == "float32"
+    assert cli["load"] is True and cli["model_file"] == "/x/y.npz"
+
+
+def test_cli_rejects_unknown_field():
+    with pytest.raises(SystemExit):
+        build_config(["--set", "definitely_not_a_field=1"])
+
+
+# ---------------------------------------------------------------------------
+# summary writer wire format
+# ---------------------------------------------------------------------------
+
+
+def _read_records(path):
+    """Decode TFRecord framing, verifying both masked CRCs."""
+    records = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if not header:
+                return records
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            assert hcrc == _masked_crc(header)
+            payload = f.read(length)
+            (pcrc,) = struct.unpack("<I", f.read(4))
+            assert pcrc == _masked_crc(payload)
+            records.append(payload)
+
+
+def test_summary_writer_tfevents_roundtrip(tmp_path):
+    with SummaryWriter(str(tmp_path)) as w:
+        w.scalars(1, {"loss": 2.5, "acc": 0.5})
+        w.scalars(2, {"loss": float("nan"), "acc": 1.0})  # nan dropped
+
+    event_file = [f for f in os.listdir(tmp_path) if f.startswith("events.out")][0]
+    records = _read_records(os.path.join(tmp_path, event_file))
+    # file_version event + 2 scalar events
+    assert len(records) == 3
+    assert b"brain.Event:2" in records[0]
+    assert b"loss" in records[1] and b"acc" in records[1]
+    # step-2 record must only contain the finite scalar
+    assert b"acc" in records[2] and b"loss" not in records[2]
+    # float payload of loss=2.5 present in record 1
+    assert struct.pack("<f", 2.5) in records[1]
+
+    rows = [json.loads(x) for x in open(tmp_path / "metrics.jsonl")]
+    assert rows[0] == {"step": 1, "loss": 2.5, "acc": 0.5}
+    assert rows[1] == {"step": 2, "acc": 1.0}
+
+
+def test_eval_sweep_scores_every_checkpoint(trained):
+    config, _ = trained
+    sweep = runtime.evaluate_sweep(config)
+    assert sorted(sweep) == [3, 6]                 # save_period=3 over 6 steps
+    for step, scores in sweep.items():
+        assert "Bleu_4" in scores
+        assert os.path.exists(os.path.join(config.save_dir, f"{step}.txt"))
