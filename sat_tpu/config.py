@@ -110,6 +110,10 @@ class Config:
     decode_on_device: bool = True      # lax.scan beam search vs host loop
     num_data_workers: int = 8          # image-decode thread pool
     log_every: int = 10                # metric-writer cadence (steps)
+    var_summary_period: int = 0        # per-variable stats cadence (0=off)
+    profile_dir: str = ""              # jax.profiler trace dir ("" = off)
+    profile_start_step: int = 5        # first step inside the trace
+    profile_num_steps: int = 3         # steps captured per trace
     global_step: int = 0               # persisted into checkpoints
 
     def replace(self, **kw: Any) -> "Config":

@@ -108,10 +108,22 @@ def train(
     )
     root_rng = jax.random.PRNGKey(seed + 1)
 
+    profiling = False
+    profiled = False
     with SummaryWriter(config.summary_dir) as writer:
         for epoch in range(config.num_epochs):
             for batch in loader:
-                step_rng = jax.random.fold_in(root_rng, int(state.step))
+                step = int(state.step)  # step about to run
+                # >= not ==: a run resumed past profile_start_step still
+                # profiles (once) instead of silently never tracing
+                if (
+                    config.profile_dir
+                    and not profiled
+                    and step >= config.profile_start_step
+                ):
+                    jax.profiler.start_trace(config.profile_dir)
+                    profiling = profiled = True
+                    profile_stop_step = step + config.profile_num_steps
                 state, metrics = train_step(
                     state,
                     {
@@ -119,15 +131,27 @@ def train(
                         "word_idxs": batch["word_idxs"],
                         "masks": batch["masks"],
                     },
-                    step_rng,
+                    jax.random.fold_in(root_rng, step),
                 )
                 step = int(state.step)
+                if profiling and step >= profile_stop_step:
+                    jax.block_until_ready(state)
+                    jax.profiler.stop_trace()
+                    profiling = False
                 if step % config.log_every == 0:
                     host = {k: float(v) for k, v in jax.device_get(metrics).items()}
                     writer.scalars(step, host)
+                if (
+                    config.var_summary_period
+                    and step % config.var_summary_period == 0
+                ):
+                    writer.variable_stats(step, state.params)
                 if config.save_period and step % config.save_period == 0:
                     save_checkpoint(state, config)
             print(f"epoch {epoch + 1}/{config.num_epochs} done (step {int(state.step)})")
+        if profiling:
+            jax.block_until_ready(state)
+            jax.profiler.stop_trace()
         save_checkpoint(state, config)
     return state
 

@@ -84,6 +84,12 @@ def make_train_step(config: Config):
         )
         metrics = dict(aux["metrics"])
         metrics["grad_norm"] = optax.global_norm(grads)
+        # attention-map stats (the reference's attentions summary,
+        # model.py:538-540): Σ_t α per context position, ideally ≈1
+        att = aux["attentions"]
+        metrics["attention/mean"] = jnp.mean(att)
+        metrics["attention/std"] = jnp.std(att)
+        metrics["attention/max"] = jnp.max(att)
         return new_state, metrics
 
     return train_step

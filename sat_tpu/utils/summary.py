@@ -102,6 +102,28 @@ def _frame_record(payload: bytes) -> bytes:
     )
 
 
+def _reduce_stats(leaf_list):
+    """On-device (mean, std, min, max) per array; jitted once at module
+    level so periodic variable_stats calls hit the compile cache."""
+    import jax
+
+    global _reduce_stats_jit
+    if _reduce_stats_jit is None:
+        import jax.numpy as jnp
+
+        @jax.jit
+        def reduce_all(leaves):
+            return [
+                (jnp.mean(x), jnp.std(x), jnp.min(x), jnp.max(x)) for x in leaves
+            ]
+
+        _reduce_stats_jit = reduce_all
+    return _reduce_stats_jit(leaf_list)
+
+
+_reduce_stats_jit = None
+
+
 # ---------------------------------------------------------------------------
 # Writer
 # ---------------------------------------------------------------------------
@@ -147,21 +169,14 @@ class SummaryWriter:
         variable_summary for every trainable (model.py:516-524).  Arrays
         are reduced on device before the host transfer."""
         import jax
-        import jax.numpy as jnp
 
         stats = {}
         leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
         if max_vars:
             leaves = leaves[:max_vars]
 
-        @jax.jit
-        def reduce_all(leaf_list):
-            return [
-                (jnp.mean(x), jnp.std(x), jnp.min(x), jnp.max(x)) for x in leaf_list
-            ]
-
         arrays = [leaf for _, leaf in leaves]
-        reduced = jax.device_get(reduce_all(arrays))
+        reduced = jax.device_get(_reduce_stats(arrays))
         for (path, _), (mean, std, lo, hi) in zip(leaves, reduced):
             name = prefix + "/" + "/".join(str(getattr(e, "key", getattr(e, "name", e))) for e in path)
             stats[f"{name}/mean"] = mean

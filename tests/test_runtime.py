@@ -173,3 +173,31 @@ def test_eval_sweep_scores_every_checkpoint(trained):
     for step, scores in sweep.items():
         assert "Bleu_4" in scores
         assert os.path.exists(os.path.join(config.save_dir, f"{step}.txt"))
+
+
+def test_train_with_profiler_and_var_stats(coco_fixture, tmp_path):
+    """Profiler trace + per-variable stats hooks (SURVEY.md §5 tracing)."""
+    config = coco_fixture["config"].replace(
+        **{**SMALL_MODEL,
+           "save_dir": str(tmp_path / "models"),
+           "summary_dir": str(tmp_path / "summary"),
+           "var_summary_period": 3,
+           "profile_dir": str(tmp_path / "profile"),
+           "profile_start_step": 1,
+           "profile_num_steps": 2}
+    )
+    runtime.train(config)
+    # a trace directory with at least one artifact was produced
+    produced = []
+    for root, _, files in os.walk(tmp_path / "profile"):
+        produced += files
+    assert produced, "no profiler trace written"
+    # variable stats rows present at the configured cadence
+    rows = [
+        json.loads(line)
+        for line in open(os.path.join(config.summary_dir, "metrics.jsonl"))
+    ]
+    stat_rows = [r for r in rows if any(k.startswith("params/") for k in r)]
+    assert {r["step"] for r in stat_rows} == {3, 6}
+    # attention stats ride along with normal metrics
+    assert any("attention/mean" in r for r in rows)
