@@ -61,8 +61,8 @@ _RULES = [
 ]
 
 
-def tokenize(text: str, lower: bool = True) -> List[str]:
-    """Tokenize one sentence into Treebank-style word tokens."""
+def tokenize_pure(text: str, lower: bool = True) -> List[str]:
+    """Pure-Python Treebank tokenization (reference rule set)."""
     if lower:
         text = text.lower()
     text = " " + text.strip() + " "
@@ -74,10 +74,32 @@ def tokenize(text: str, lower: bool = True) -> List[str]:
     return text.split()
 
 
+def _native_eligible(text: str, lower: bool) -> bool:
+    """The C++ tokenizer is byte-wise ASCII and implements only the
+    lowercased rule path; route anything else to the Python rules so the
+    two backends can never disagree on the same input."""
+    return lower and text.isascii()
+
+
+def tokenize(text: str, lower: bool = True) -> List[str]:
+    """Tokenize one sentence into Treebank-style word tokens.  Uses the
+    C++ tokenizer (sat_tpu/native) when built, else the Python rules —
+    the two are golden-tested for identical output."""
+    from .. import native
+
+    if _native_eligible(text, lower) and native.available():
+        return native.tokenize(text, lower=lower)
+    return tokenize_pure(text, lower=lower)
+
+
 def tokenize_no_punct(text: str, lower: bool = True) -> List[str]:
     """Tokenize and drop punctuation tokens — the metric-eval flavour
     (reference ptbtokenizer.py:65-66 removes PUNCTUATIONS post-hoc)."""
-    return [t for t in tokenize(text, lower=lower) if t not in PUNCTUATIONS]
+    from .. import native
+
+    if _native_eligible(text, lower) and native.available():
+        return native.tokenize(text, lower=lower, strip_punct=True)
+    return [t for t in tokenize_pure(text, lower=lower) if t not in PUNCTUATIONS]
 
 
 def tokenize_captions(captions: Iterable[str]) -> List[str]:

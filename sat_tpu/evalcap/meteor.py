@@ -44,7 +44,10 @@ def _stem(word: str) -> str:
         try:
             from nltk.stem.porter import PorterStemmer
 
-            _stemmer = PorterStemmer()
+            # ORIGINAL_ALGORITHM: bit-for-bit the published Porter (1980)
+            # steps, which is what the C++ aligner implements — keeps the
+            # native and Python scorers in exact agreement.
+            _stemmer = PorterStemmer(mode="ORIGINAL_ALGORITHM")
         except Exception:  # pragma: no cover - nltk is baked into the image
             _stemmer = False
     if _stemmer:
@@ -124,6 +127,13 @@ def score_from_stats(s: Dict[str, float]) -> float:
 
 
 def meteor_single(hypothesis: str, references: List[str]) -> float:
+    from .. import native
+
+    # The C++ scorer is ASCII/lowercase (like its Porter stage); anything
+    # else goes through the Python twin so backends always agree.
+    ascii_ok = hypothesis.isascii() and all(r.isascii() for r in references)
+    if ascii_ok and native.available():
+        return native.meteor_multi(hypothesis, list(references))
     return max(score_from_stats(segment_stats(hypothesis, r)) for r in references)
 
 

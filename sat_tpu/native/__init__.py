@@ -1,0 +1,139 @@
+"""ctypes bindings for the native (C++) components.
+
+The reference outsources PTB tokenization and METEOR scoring to two
+external Java jars run as subprocesses (/root/reference/utils/coco/
+pycocoevalcap/tokenizer/ptbtokenizer.py:18-69, meteor/meteor.py:15-58).
+This package replaces them with an in-process C++ shared library — no
+JVM, no subprocess pipes — loaded via ctypes (pybind11 is not available
+in this environment).
+
+Loading policy:
+* ``SAT_TPU_NO_NATIVE=1`` disables the library (pure-Python fallbacks in
+  sat_tpu.data.tokenizer / sat_tpu.evalcap.meteor are used);
+* otherwise ``libsat_native.so`` next to this file is loaded, building it
+  with ``make`` on first use when a toolchain is present;
+* all consumers call :func:`get_lib` and fall back to Python when it
+  returns None, so the framework works on machines without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libsat_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_attempted = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.sat_tokenize.restype = ctypes.c_void_p
+    lib.sat_tokenize.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.sat_stem.restype = ctypes.c_void_p
+    lib.sat_stem.argtypes = [ctypes.c_char_p]
+    lib.sat_meteor_segment.restype = ctypes.c_double
+    lib.sat_meteor_segment.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.sat_meteor_multi.restype = ctypes.c_double
+    lib.sat_meteor_multi.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int,
+    ]
+    lib.sat_free.restype = None
+    lib.sat_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def build(force: bool = False) -> bool:
+    """Compile libsat_native.so via make.  Returns success."""
+    if force:
+        subprocess.run(
+            ["make", "-C", _HERE, "clean"], capture_output=True, check=False
+        )
+    result = subprocess.run(
+        ["make", "-C", _HERE], capture_output=True, text=True, check=False
+    )
+    if result.returncode != 0:
+        return False
+    return os.path.exists(_LIB_PATH)
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None (build failed / disabled)."""
+    global _lib, _lib_attempted
+    if os.environ.get("SAT_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _lib_attempted:
+            return _lib
+        _lib_attempted = True
+        try:
+            if not os.path.exists(_LIB_PATH):
+                if not build():
+                    return None
+            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _take_string(lib: ctypes.CDLL, ptr: int) -> str:
+    try:
+        return ctypes.cast(ptr, ctypes.c_char_p).value.decode("utf-8")
+    finally:
+        lib.sat_free(ptr)
+
+
+def tokenize(text: str, lower: bool = True, strip_punct: bool = False) -> List[str]:
+    """Native PTB tokenization; raises RuntimeError if unavailable
+    (callers are expected to check :func:`available` first)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    ptr = lib.sat_tokenize(
+        text.encode("utf-8"), int(lower), int(strip_punct)
+    )
+    if not ptr:
+        return []
+    joined = _take_string(lib, ptr)
+    return joined.split() if joined else []
+
+
+def stem(word: str) -> str:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    ptr = lib.sat_stem(word.encode("utf-8"))
+    return _take_string(lib, ptr)
+
+
+def meteor_segment(hyp_tokens: str, ref_tokens: str) -> float:
+    """METEOR for one (hypothesis, reference) pair of space-joined
+    token strings."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return float(
+        lib.sat_meteor_segment(hyp_tokens.encode("utf-8"), ref_tokens.encode("utf-8"))
+    )
+
+
+def meteor_multi(hyp_tokens: str, ref_tokens: Sequence[str]) -> float:
+    """METEOR against multiple references (max, jar behavior)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    refs = (ctypes.c_char_p * len(ref_tokens))(
+        *[r.encode("utf-8") for r in ref_tokens]
+    )
+    return float(lib.sat_meteor_multi(hyp_tokens.encode("utf-8"), refs, len(refs)))

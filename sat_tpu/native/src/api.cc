@@ -1,0 +1,77 @@
+// C ABI for the sat_tpu native components, consumed via ctypes
+// (sat_tpu/native/__init__.py).  Strings are UTF-8; returned buffers are
+// malloc'd and must be released with sat_free.
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace sat_native {
+std::vector<std::string> ptb_tokenize(const std::string&, bool);
+std::vector<std::string> ptb_tokenize_no_punct(const std::string&, bool);
+std::string porter_stem(const std::string&);
+double meteor_segment(const std::string&, const std::string&);
+}  // namespace sat_native
+
+namespace {
+
+char* dup_string(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  if (out != nullptr) std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+char* join_tokens(const std::vector<std::string>& tokens) {
+  std::string joined;
+  for (size_t i = 0; i < tokens.size(); i++) {
+    if (i) joined += ' ';
+    joined += tokens[i];
+  }
+  return dup_string(joined);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Tokenize one sentence; returns space-joined tokens (malloc'd).
+char* sat_tokenize(const char* text, int lowercase, int strip_punct) {
+  if (text == nullptr) return nullptr;
+  auto tokens = strip_punct
+                    ? sat_native::ptb_tokenize_no_punct(text, lowercase != 0)
+                    : sat_native::ptb_tokenize(text, lowercase != 0);
+  return join_tokens(tokens);
+}
+
+// Porter-stem one word (malloc'd).
+char* sat_stem(const char* word) {
+  if (word == nullptr) return nullptr;
+  return dup_string(sat_native::porter_stem(word));
+}
+
+// METEOR score of one hypothesis against one reference, both given as
+// space-joined token strings.
+double sat_meteor_segment(const char* hyp, const char* ref) {
+  if (hyp == nullptr || ref == nullptr) return 0.0;
+  return sat_native::meteor_segment(hyp, ref);
+}
+
+// METEOR with multiple references: max over refs (jar behavior).
+// refs: array of n space-joined token strings.
+double sat_meteor_multi(const char* hyp, const char** refs, int n) {
+  if (hyp == nullptr || refs == nullptr) return 0.0;
+  double best = 0.0;
+  for (int i = 0; i < n; i++) {
+    if (refs[i] == nullptr) continue;
+    double s = sat_native::meteor_segment(hyp, refs[i]);
+    if (s > best) best = s;
+  }
+  return best;
+}
+
+void sat_free(char* p) { std::free(p); }
+
+int sat_native_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,117 @@
+// METEOR segment scorer — native replacement for the reference's
+// persistent meteor-1.5.jar subprocess (/root/reference/utils/coco/
+// pycocoevalcap/meteor/meteor.py:15-58).
+//
+// Mirror of the Python implementation in sat_tpu/evalcap/meteor.py
+// (golden-tested against it): stage-wise greedy alignment — exact match
+// (weight 1.0) then Porter-stem match (weight 0.6) with
+// nearest-occurrence pairing — and classic METEOR scoring with α=0.9,
+// β=3, γ=0.5 fragmentation penalty; multi-reference takes the max.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdlib>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace sat_native {
+
+std::string porter_stem(const std::string& input);
+
+namespace {
+
+constexpr double kAlpha = 0.9;
+constexpr double kBeta = 3.0;
+constexpr double kGamma = 0.5;
+constexpr double kExactWeight = 1.0;
+constexpr double kStemWeight = 0.6;
+
+std::vector<std::string> split_ws(const std::string& s) {
+  std::vector<std::string> out;
+  size_t i = 0;
+  while (i < s.size()) {
+    while (i < s.size() && s[i] == ' ') i++;
+    size_t start = i;
+    while (i < s.size() && s[i] != ' ') i++;
+    if (i > start) out.push_back(s.substr(start, i - start));
+  }
+  return out;
+}
+
+struct Match {
+  int hyp_idx;
+  int ref_idx;
+  double weight;
+};
+
+void run_stage(const std::vector<std::string>& hyp_keys,
+               const std::vector<std::string>& ref_keys,
+               std::vector<bool>* hyp_used, std::vector<bool>* ref_used,
+               double weight, std::vector<Match>* matches) {
+  std::map<std::string, std::vector<int>> ref_slots;
+  for (int j = 0; j < static_cast<int>(ref_keys.size()); j++) {
+    if (!(*ref_used)[j]) ref_slots[ref_keys[j]].push_back(j);
+  }
+  for (int i = 0; i < static_cast<int>(hyp_keys.size()); i++) {
+    if ((*hyp_used)[i]) continue;
+    auto it = ref_slots.find(hyp_keys[i]);
+    if (it == ref_slots.end() || it->second.empty()) continue;
+    // nearest remaining reference occurrence to position i
+    auto& slots = it->second;
+    auto best = std::min_element(
+        slots.begin(), slots.end(),
+        [i](int a, int b) { return std::abs(a - i) < std::abs(b - i); });
+    int j = *best;
+    slots.erase(best);
+    (*hyp_used)[i] = true;
+    (*ref_used)[j] = true;
+    matches->push_back({i, j, weight});
+  }
+}
+
+}  // namespace
+
+double meteor_segment(const std::string& hypothesis,
+                      const std::string& reference) {
+  std::vector<std::string> hyp = split_ws(hypothesis);
+  std::vector<std::string> ref = split_ws(reference);
+  if (hyp.empty() || ref.empty()) return 0.0;
+
+  std::vector<bool> hyp_used(hyp.size(), false), ref_used(ref.size(), false);
+  std::vector<Match> matches;
+  run_stage(hyp, ref, &hyp_used, &ref_used, kExactWeight, &matches);
+
+  std::vector<std::string> hyp_stems(hyp.size()), ref_stems(ref.size());
+  for (size_t i = 0; i < hyp.size(); i++) hyp_stems[i] = porter_stem(hyp[i]);
+  for (size_t j = 0; j < ref.size(); j++) ref_stems[j] = porter_stem(ref[j]);
+  run_stage(hyp_stems, ref_stems, &hyp_used, &ref_used, kStemWeight,
+            &matches);
+
+  if (matches.empty()) return 0.0;
+  std::sort(matches.begin(), matches.end(),
+            [](const Match& a, const Match& b) {
+              return a.hyp_idx != b.hyp_idx ? a.hyp_idx < b.hyp_idx
+                                            : a.ref_idx < b.ref_idx;
+            });
+
+  double weighted = 0.0;
+  for (const auto& m : matches) weighted += m.weight;
+  int chunks = 1;
+  for (size_t k = 1; k < matches.size(); k++) {
+    if (!(matches[k].hyp_idx == matches[k - 1].hyp_idx + 1 &&
+          matches[k].ref_idx == matches[k - 1].ref_idx + 1)) {
+      chunks++;
+    }
+  }
+
+  double p = weighted / hyp.size();
+  double r = weighted / ref.size();
+  if (p == 0.0 || r == 0.0) return 0.0;
+  double fmean = (p * r) / (kAlpha * p + (1.0 - kAlpha) * r);
+  double frag = static_cast<double>(chunks) / matches.size();
+  double penalty = kGamma * std::pow(frag, kBeta);
+  return fmean * (1.0 - penalty);
+}
+
+}  // namespace sat_native

@@ -1,0 +1,187 @@
+// Porter stemmer — the original 1980 algorithm.
+//
+// Native replacement half of the METEOR scorer (the reference delegates
+// stemming to the external meteor-1.5.jar, /root/reference/utils/coco/
+// pycocoevalcap/meteor/meteor.py:15-19).  Implemented from the published
+// algorithm description (Porter, "An algorithm for suffix stripping",
+// Program 14(3) 1980); kept in lockstep with nltk's ORIGINAL_ALGORITHM
+// mode, which the Python fallback uses (sat_tpu/evalcap/meteor.py).
+
+#include <cctype>
+#include <string>
+
+namespace sat_native {
+
+namespace {
+
+bool is_consonant(const std::string& w, int i) {
+  char c = w[i];
+  if (c == 'a' || c == 'e' || c == 'i' || c == 'o' || c == 'u') return false;
+  if (c == 'y') return i == 0 ? true : !is_consonant(w, i - 1);
+  return true;
+}
+
+// measure m(): number of VC sequences in w[0..end]
+int measure(const std::string& w) {
+  int m = 0;
+  int i = 0;
+  int n = static_cast<int>(w.size());
+  while (i < n && is_consonant(w, i)) i++;          // leading C*
+  while (i < n) {
+    while (i < n && !is_consonant(w, i)) i++;       // V+
+    if (i >= n) break;
+    while (i < n && is_consonant(w, i)) i++;        // C+
+    m++;
+  }
+  return m;
+}
+
+bool contains_vowel(const std::string& w) {
+  for (int i = 0; i < static_cast<int>(w.size()); i++)
+    if (!is_consonant(w, i)) return true;
+  return false;
+}
+
+bool double_consonant(const std::string& w) {
+  int n = static_cast<int>(w.size());
+  return n >= 2 && w[n - 1] == w[n - 2] && is_consonant(w, n - 1);
+}
+
+// *o: stem ends cvc where the final c is not w, x or y
+bool ends_cvc(const std::string& w) {
+  int n = static_cast<int>(w.size());
+  if (n < 3) return false;
+  char c = w[n - 1];
+  return is_consonant(w, n - 3) && !is_consonant(w, n - 2) &&
+         is_consonant(w, n - 1) && c != 'w' && c != 'x' && c != 'y';
+}
+
+bool ends_with(const std::string& w, const std::string& suf) {
+  return w.size() >= suf.size() &&
+         w.compare(w.size() - suf.size(), suf.size(), suf) == 0;
+}
+
+std::string chop(const std::string& w, size_t k) {
+  return w.substr(0, w.size() - k);
+}
+
+// apply first matching (suffix, replacement) rule whose stem measure
+// condition holds; returns true if a rule's suffix matched (even if the
+// condition failed — Porter's rules stop at the first suffix match)
+struct Rule {
+  const char* suf;
+  const char* rep;
+  int min_m;  // condition: m(stem) > min_m  (−1 = unconditional)
+};
+
+bool apply_rules(std::string* w, const Rule* rules, int n_rules) {
+  for (int r = 0; r < n_rules; r++) {
+    const std::string suf = rules[r].suf;
+    if (ends_with(*w, suf)) {
+      std::string stem = chop(*w, suf.size());
+      if (rules[r].min_m < 0 || measure(stem) > rules[r].min_m) {
+        *w = stem + rules[r].rep;
+      }
+      return true;
+    }
+  }
+  return false;
+}
+
+}  // namespace
+
+std::string porter_stem(const std::string& input) {
+  std::string w = input;
+  // nltk's PorterStemmer.stem() lowercases before the steps; match it
+  // (ASCII only — callers gate non-ASCII to the Python path).
+  for (char& c : w) c = std::tolower(static_cast<unsigned char>(c));
+  if (w.empty()) return w;
+  // No short-word guard: nltk's ORIGINAL_ALGORITHM mode (which the Python
+  // fallback is pinned to) applies the steps to words of every length.
+
+  // ---- step 1a
+  if (ends_with(w, "sses")) w = chop(w, 2);
+  else if (ends_with(w, "ies")) w = chop(w, 2);
+  else if (ends_with(w, "ss")) { /* unchanged */ }
+  else if (ends_with(w, "s")) w = chop(w, 1);
+
+  // ---- step 1b
+  bool did_1b_23 = false;
+  if (ends_with(w, "eed")) {
+    if (measure(chop(w, 3)) > 0) w = chop(w, 1);
+  } else if (ends_with(w, "ed")) {
+    if (contains_vowel(chop(w, 2))) { w = chop(w, 2); did_1b_23 = true; }
+  } else if (ends_with(w, "ing")) {
+    if (contains_vowel(chop(w, 3))) { w = chop(w, 3); did_1b_23 = true; }
+  }
+  if (did_1b_23) {
+    if (ends_with(w, "at") || ends_with(w, "bl") || ends_with(w, "iz")) {
+      w += "e";
+    } else if (double_consonant(w) && !ends_with(w, "l") &&
+               !ends_with(w, "s") && !ends_with(w, "z")) {
+      w = chop(w, 1);
+    } else if (measure(w) == 1 && ends_cvc(w)) {
+      w += "e";
+    }
+  }
+
+  // ---- step 1c
+  if (ends_with(w, "y") && contains_vowel(chop(w, 1))) {
+    w = chop(w, 1) + "i";
+  }
+
+  // ---- step 2  (condition m > 0)
+  static const Rule step2[] = {
+      {"ational", "ate", 0}, {"tional", "tion", 0}, {"enci", "ence", 0},
+      {"anci", "ance", 0},   {"izer", "ize", 0},    {"abli", "able", 0},
+      {"alli", "al", 0},     {"entli", "ent", 0},   {"eli", "e", 0},
+      {"ousli", "ous", 0},   {"ization", "ize", 0}, {"ation", "ate", 0},
+      {"ator", "ate", 0},    {"alism", "al", 0},    {"iveness", "ive", 0},
+      {"fulness", "ful", 0}, {"ousness", "ous", 0}, {"aliti", "al", 0},
+      {"iviti", "ive", 0},   {"biliti", "ble", 0},
+  };
+  apply_rules(&w, step2, sizeof(step2) / sizeof(Rule));
+
+  // ---- step 3  (condition m > 0)
+  static const Rule step3[] = {
+      {"icate", "ic", 0}, {"ative", "", 0}, {"alize", "al", 0},
+      {"iciti", "ic", 0}, {"ical", "ic", 0}, {"ful", "", 0}, {"ness", "", 0},
+  };
+  apply_rules(&w, step3, sizeof(step3) / sizeof(Rule));
+
+  // ---- step 4  (condition m > 1; 'ion' additionally needs stem ending s/t)
+  for (const char* suf :
+       {"al", "ance", "ence", "er", "ic", "able", "ible", "ant", "ement",
+        "ment", "ent", "ion", "ou", "ism", "ate", "iti", "ous", "ive",
+        "ize"}) {
+    std::string s = suf;
+    if (ends_with(w, s)) {
+      std::string stem = chop(w, s.size());
+      if (measure(stem) > 1) {
+        if (s == "ion") {
+          if (!stem.empty() &&
+              (stem.back() == 's' || stem.back() == 't')) {
+            w = stem;
+          }
+        } else {
+          w = stem;
+        }
+      }
+      break;  // first suffix match wins
+    }
+  }
+
+  // ---- step 5a
+  if (ends_with(w, "e")) {
+    std::string stem = chop(w, 1);
+    int m = measure(stem);
+    if (m > 1 || (m == 1 && !ends_cvc(stem))) w = stem;
+  }
+  // ---- step 5b
+  if (measure(w) > 1 && double_consonant(w) && ends_with(w, "l")) {
+    w = chop(w, 1);
+  }
+  return w;
+}
+
+}  // namespace sat_native

@@ -1,0 +1,360 @@
+// Penn-Treebank-style tokenizer — native replacement for the reference's
+// Stanford CoreNLP PTBTokenizer jar invocation (/root/reference/utils/coco/
+// pycocoevalcap/tokenizer/ptbtokenizer.py:18-69, `-preserveLines
+// -lowerCase` + punctuation stripping).
+//
+// Rule-for-rule mirror of the Python implementation in
+// sat_tpu/data/tokenizer.py (the two are golden-tested against each other);
+// regexes are hand-compiled into scans for speed and to avoid std::regex
+// semantic drift from Python `re`.
+
+#include <cctype>
+#include <string>
+#include <unordered_set>
+#include <vector>
+
+namespace sat_native {
+
+namespace {
+
+const std::unordered_set<std::string>& punctuations() {
+  static const std::unordered_set<std::string> kPunct = {
+      "''", "'",  "``", "`",  "-LRB-", "-RRB-", "-LCB-", "-RCB-",
+      ".",  "?",  "!",  ",",  ":",     "-",     "--",    "...",  ";",
+  };
+  return kPunct;
+}
+
+bool is_space(char c) { return std::isspace(static_cast<unsigned char>(c)); }
+
+// Ordered regex-equivalent passes over the working string.  Each pass
+// rebuilds the string; captions are short so this is still ~µs each.
+
+std::string lower(const std::string& s) {
+  std::string out = s;
+  for (char& c : out) c = std::tolower(static_cast<unsigned char>(c));
+  return out;
+}
+
+// ^" → ``   (string starts with a double quote)
+std::string rule_start_quote(const std::string& s) {
+  if (!s.empty() && s[0] == '"') return "``" + s.substr(1);
+  return s;
+}
+
+// (``) → ' `` '
+std::string rule_pad_backticks(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); i++) {
+    if (s[i] == '`' && i + 1 < s.size() && s[i + 1] == '`') {
+      out += " `` ";
+      i++;
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+// ([ ([{<])("|'{2}) → \1 ``
+std::string rule_open_quote(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); i++) {
+    char c = s[i];
+    out += c;
+    if (c == ' ' || c == '(' || c == '[' || c == '{' || c == '<') {
+      if (i + 1 < s.size() && s[i + 1] == '"') {
+        out += " `` ";
+        i += 1;
+      } else if (i + 2 < s.size() && s[i + 1] == '\'' && s[i + 2] == '\'') {
+        out += " `` ";
+        i += 2;
+      }
+    }
+  }
+  return out;
+}
+
+// ... → ' ... '
+std::string rule_ellipsis(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); i++) {
+    if (s[i] == '.' && i + 2 < s.size() && s[i + 1] == '.' && s[i + 2] == '.') {
+      out += " ... ";
+      i += 2;
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+// ([;@#$%&?!]) → ' \1 '
+std::string rule_punct(const std::string& s) {
+  static const std::string kSet = ";@#$%&?!";
+  std::string out;
+  for (char c : s) {
+    if (kSet.find(c) != std::string::npos) {
+      out += ' ';
+      out += c;
+      out += ' ';
+    } else {
+      out += c;
+    }
+  }
+  return out;
+}
+
+// ([^.])(.)(?=\s) → '\1 \2 '   — sentence-internal period before whitespace
+std::string rule_internal_period(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); i++) {
+    if (s[i] == '.' && i > 0 && s[i - 1] != '.' && i + 1 < s.size() &&
+        is_space(s[i + 1])) {
+      out += " . ";
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+// ([^.])(\.)([])}>"']*)\s*$ → '\1 \2\3 '  — final period (+closers)
+std::string rule_final_period(const std::string& s) {
+  // find last non-space
+  int end = static_cast<int>(s.size()) - 1;
+  while (end >= 0 && is_space(s[end])) end--;
+  if (end < 0) return s;
+  // scan back over closers
+  int i = end;
+  static const std::string kClosers = "])}>\"'";
+  while (i >= 0 && kClosers.find(s[i]) != std::string::npos) i--;
+  if (i < 1 || s[i] != '.' || s[i - 1] == '.') return s;
+  // s[i] is the final period, s[i+1..end] closers, preceded by non-period
+  std::string out = s.substr(0, i);
+  out += " .";
+  out += s.substr(i + 1, end - i);
+  out += " ";
+  return out;
+}
+
+// ([:,])([^\d]) → ' \1 \2'  and ([:,])$ → ' \1 '
+std::string rule_comma_colon(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); i++) {
+    char c = s[i];
+    if (c == ':' || c == ',') {
+      if (i + 1 >= s.size()) {
+        out += ' ';
+        out += c;
+        out += ' ';
+      } else if (!std::isdigit(static_cast<unsigned char>(s[i + 1]))) {
+        out += ' ';
+        out += c;
+        out += ' ';
+        // NB: python rule consumes the next char into \2 — but since it
+        // re-emits it unchanged, emitting it on the next loop turn is
+        // equivalent EXCEPT for overlapping ",," sequences, where re.sub
+        // skips the consumed char.  Reproduce that: if next is ':'/',',
+        // emit it verbatim now.
+        char n = s[i + 1];
+        if (n == ':' || n == ',') {
+          out += n;
+          i++;
+        }
+      } else {
+        out += c;
+      }
+    } else {
+      out += c;
+    }
+  }
+  return out;
+}
+
+// ([][(){}<>]) → ' \1 '
+std::string rule_brackets(const std::string& s) {
+  static const std::string kSet = "[](){}<>";
+  std::string out;
+  for (char c : s) {
+    if (kSet.find(c) != std::string::npos) {
+      out += ' ';
+      out += c;
+      out += ' ';
+    } else {
+      out += c;
+    }
+  }
+  return out;
+}
+
+// -- → ' -- '
+std::string rule_dashes(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); i++) {
+    if (s[i] == '-' && i + 1 < s.size() && s[i + 1] == '-') {
+      out += " -- ";
+      i++;
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+// " → ' '' '
+std::string rule_end_quote(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"') out += " '' ";
+    else out += c;
+  }
+  return out;
+}
+
+// (\S)('') → '\1 '' '
+std::string rule_pad_close_quote(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); i++) {
+    if (s[i] == '\'' && i + 1 < s.size() && s[i + 1] == '\'' && i > 0 &&
+        !is_space(s[i - 1])) {
+      out += " '' ";
+      i++;
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+// ([^' ])(' ) → "\1 ' "
+std::string rule_trailing_apostrophe(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); i++) {
+    if (s[i] == '\'' && i + 1 < s.size() && s[i + 1] == ' ' && i > 0 &&
+        s[i - 1] != '\'' && s[i - 1] != ' ') {
+      out += " ' ";
+      i++;  // consumed the space into the replacement
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+// contractions: ([^' ])('ll|'re|'ve|n't|'s|'m|'d)\b → "\1 \2"
+std::string rule_contractions(const std::string& s) {
+  static const std::vector<std::string> kSuf = {"'ll", "'re", "'ve",
+                                                "n't", "'s",  "'m", "'d"};
+  std::string out;
+  size_t i = 0;
+  while (i < s.size()) {
+    bool matched = false;
+    if (i > 0 && s[i - 1] != '\'' && s[i - 1] != ' ') {
+      for (const auto& suf : kSuf) {
+        if (s.compare(i, suf.size(), suf) == 0) {
+          size_t after = i + suf.size();
+          bool boundary =
+              after >= s.size() ||
+              !(std::isalnum(static_cast<unsigned char>(s[after])) ||
+                s[after] == '_');
+          if (boundary) {
+            out += ' ';
+            out += suf;
+            i = after;
+            matched = true;
+            break;
+          }
+        }
+      }
+    }
+    if (!matched) {
+      out += s[i];
+      i++;
+    }
+  }
+  return out;
+}
+
+// multiword: cannot/gonna/gotta/wanna/lemme → split
+std::string rule_multiword(const std::string& s) {
+  static const std::vector<std::pair<std::string, std::string>> kPairs = {
+      {"cannot", "can not"}, {"gonna", "gon na"}, {"gotta", "got ta"},
+      {"wanna", "wan na"},   {"lemme", "lem me"},
+  };
+  std::string out;
+  size_t i = 0;
+  auto word_char = [&](size_t k) {
+    return k < s.size() && (std::isalnum(static_cast<unsigned char>(s[k])) ||
+                            s[k] == '_');
+  };
+  while (i < s.size()) {
+    bool matched = false;
+    bool at_start = i == 0 || !word_char(i - 1);
+    if (at_start) {
+      for (const auto& [from, to] : kPairs) {
+        if (s.compare(i, from.size(), from) == 0 &&
+            !word_char(i + from.size())) {
+          out += to;
+          i += from.size();
+          matched = true;
+          break;
+        }
+      }
+    }
+    if (!matched) {
+      out += s[i];
+      i++;
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+std::vector<std::string> ptb_tokenize(const std::string& text,
+                                      bool lowercase) {
+  std::string s = lowercase ? lower(text) : text;
+  // trim + pad, mirroring the Python ' ' + text.strip() + ' '
+  size_t a = 0, b = s.size();
+  while (a < b && is_space(s[a])) a++;
+  while (b > a && is_space(s[b - 1])) b--;
+  s = " " + s.substr(a, b - a) + " ";
+
+  s = rule_start_quote(s);
+  s = rule_pad_backticks(s);
+  s = rule_open_quote(s);
+  s = rule_ellipsis(s);
+  s = rule_punct(s);
+  s = rule_internal_period(s);
+  s = rule_final_period(s);
+  s = rule_comma_colon(s);
+  s = rule_brackets(s);
+  s = rule_dashes(s);
+  s = rule_end_quote(s);
+  s = rule_pad_close_quote(s);
+  s = rule_trailing_apostrophe(s);
+  s = rule_contractions(s);
+  s = rule_multiword(s);
+
+  std::vector<std::string> tokens;
+  size_t i = 0;
+  while (i < s.size()) {
+    while (i < s.size() && is_space(s[i])) i++;
+    size_t start = i;
+    while (i < s.size() && !is_space(s[i])) i++;
+    if (i > start) tokens.push_back(s.substr(start, i - start));
+  }
+  return tokens;
+}
+
+std::vector<std::string> ptb_tokenize_no_punct(const std::string& text,
+                                               bool lowercase) {
+  std::vector<std::string> out;
+  for (auto& t : ptb_tokenize(text, lowercase)) {
+    if (!punctuations().count(t)) out.push_back(std::move(t));
+  }
+  return out;
+}
+
+}  // namespace sat_native

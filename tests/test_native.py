@@ -1,0 +1,136 @@
+"""Native C++ components vs their Python twins (SURVEY.md §2.24-2.25).
+
+The reference runs a CoreNLP jar and meteor-1.5.jar; our framework ships
+C++ equivalents (sat_tpu/native).  These tests build the library and pin
+the C++ output to the pure-Python implementations token-for-token /
+score-for-score.
+"""
+
+import numpy as np
+import pytest
+
+from sat_tpu import native
+from sat_tpu.data.tokenizer import PUNCTUATIONS, tokenize_pure
+from sat_tpu.evalcap import meteor as py_meteor
+from tests.fixtures import CAPTIONS
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+TRICKY = CAPTIONS + [
+    "A man, riding a horse; on the beach!",
+    'She said "hello there" and left.',
+    "it's the dog's ball... isn't it?",
+    "don't stop -- we're nearly there.",
+    "a cat (the black one) sat on the mat.",
+    "numbers like 1,000 and 3:30 stay joined.",
+    "they'll we've you're I'm he'd cannot gonna wanna.",
+    "trailing spaces and   multiple   gaps.",
+    "the teachers' lounge was empty.",
+    "brackets [x] {y} <z> and slashes a/b.",
+    "one. two. three.",
+    "ends with colon:",
+    "weird ,, double commas ,: mixes.",
+    "",
+    "   ",
+    ".",
+    "word",
+]
+
+WORDS = sorted(
+    {w for c in TRICKY for w in tokenize_pure(c)}
+    | {
+        "running", "ran", "ponies", "caresses", "cats", "feed", "agreed",
+        "plastered", "bled", "motoring", "sing", "conflated", "troubled",
+        "sized", "hopping", "tanned", "falling", "hissing", "fizzed",
+        "failing", "filing", "happy", "sky", "relational", "conditional",
+        "rational", "valenci", "hesitanci", "digitizer", "conformabli",
+        "radicalli", "differentli", "vileli", "analogousli", "vietnamization",
+        "predication", "operator", "feudalism", "decisiveness", "hopefulness",
+        "callousness", "formaliti", "sensitiviti", "sensibiliti", "triplicate",
+        "formative", "formalize", "electriciti", "electrical", "hopeful",
+        "goodness", "revival", "allowance", "inference", "airliner",
+        "gyroscopic", "adjustable", "defensible", "irritant", "replacement",
+        "adjustment", "dependent", "adoption", "homologou", "communism",
+        "activate", "angulariti", "homologous", "effective", "bowdlerize",
+        "probate", "rate", "cease", "controll", "roll", "as", "is", "be",
+        "a", "an", "oed", "ied", "ies", "sses",
+    }
+)
+
+
+def test_stemmer_matches_nltk_original():
+    from nltk.stem.porter import PorterStemmer
+
+    ref = PorterStemmer(mode="ORIGINAL_ALGORITHM")
+    mismatches = [
+        (w, native.stem(w), ref.stem(w))
+        for w in WORDS
+        if native.stem(w) != ref.stem(w)
+    ]
+    assert not mismatches, mismatches
+
+
+@pytest.mark.parametrize("strip", [False, True])
+def test_tokenizer_matches_python(strip):
+    for caption in TRICKY:
+        if strip:
+            want = [t for t in tokenize_pure(caption) if t not in PUNCTUATIONS]
+            got = native.tokenize(caption, strip_punct=True)
+        else:
+            want = tokenize_pure(caption)
+            got = native.tokenize(caption)
+        assert got == want, f"caption={caption!r}\nwant={want}\ngot ={got}"
+
+
+def test_meteor_matches_python():
+    hyps = [" ".join(tokenize_pure(c)[:-1]) for c in CAPTIONS]
+    refs = [" ".join(tokenize_pure(c)[:-1]) for c in CAPTIONS[::-1]]
+    for hyp in hyps:
+        for ref in refs:
+            want = py_meteor.score_from_stats(py_meteor.segment_stats(hyp, ref))
+            got = native.meteor_segment(hyp, ref)
+            assert got == pytest.approx(want, abs=1e-12), (hyp, ref)
+
+
+def test_meteor_multi_is_max_over_refs():
+    hyp = "a man riding a horse on the beach"
+    refs = ["a cat on a mat", "a man riding a horse on the beach", "dogs"]
+    assert native.meteor_multi(hyp, refs) == pytest.approx(
+        max(native.meteor_segment(hyp, r) for r in refs)
+    )
+
+
+def test_meteor_scorer_class_uses_native():
+    """End-to-end through the evalcap Meteor class (native fast path)."""
+    gts = {1: ["a man riding a horse"], 2: ["two dogs playing with a ball"]}
+    res = {1: ["a man riding a horse"], 2: ["a cat sleeping"]}
+    score, scores = py_meteor.Meteor().compute_score(gts, res)
+    assert scores[0] == pytest.approx(native.meteor_segment(res[1][0], gts[1][0]))
+    assert score == pytest.approx(float(np.mean(scores)))
+    assert scores[0] > 0.9 and scores[1] < 0.2
+
+
+def test_uppercase_stem_matches_nltk():
+    from nltk.stem.porter import PorterStemmer
+
+    ref = PorterStemmer(mode="ORIGINAL_ALGORITHM")
+    for w in ["Running", "PONIES", "CaResSes"]:
+        assert native.stem(w) == ref.stem(w)
+
+
+def test_non_ascii_routes_to_python():
+    """Unicode captions must tokenize identically whether or not the
+    native library is present (they bypass it)."""
+    from sat_tpu.data.tokenizer import tokenize
+
+    text = "a café in town tonight."
+    assert tokenize(text) == tokenize_pure(text)
+
+
+def test_lower_false_routes_to_python():
+    from sat_tpu.data.tokenizer import tokenize
+
+    text = "Don't stop Cannot."
+    assert tokenize(text, lower=False) == tokenize_pure(text, lower=False)
