@@ -210,14 +210,22 @@ def attend(
     rng: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """Soft attention over the context grid → alpha [B, N]
-    (reference attend, model.py:395-436)."""
+    (reference attend, model.py:395-436).
+
+    The inference path delegates to precompute_attend +
+    attend_with_precomputed so there is exactly ONE implementation of the
+    inference math (the hoisted one beam search uses); only the
+    training/dropout path lives here."""
     p = params["attend"]
     rate = config.fc_drop_rate
     dt = jnp.dtype(config.compute_dtype)
-    if train:
-        kc, ko, kt = jax.random.split(rng, 3)
-        contexts = _dropout(kc, contexts, rate, train)
-        output = _dropout(ko, output, rate, train)
+    if not train:
+        proj = precompute_attend(params, config, contexts)
+        _, alpha = attend_with_precomputed(params, config, contexts, proj, output)
+        return alpha
+    kc, ko, kt = jax.random.split(rng, 3)
+    contexts = _dropout(kc, contexts, rate, train)
+    output = _dropout(ko, output, rate, train)
     if config.num_attend_layers == 1:
         # ctx→1 per position (no bias) + position-specific h→N projection
         logits1 = _dense(p["fc_a"], contexts, dtype=dt)[..., 0]    # [B, N]
@@ -227,10 +235,67 @@ def attend(
         t1 = _dense(p["fc_1a"], contexts, activation="tanh", dtype=dt)  # [B, N, da]
         t2 = _dense(p["fc_1b"], output, activation="tanh", dtype=dt)    # [B, da]
         temp = t1 + t2[:, None, :]
-        if train:
-            temp = _dropout(kt, temp, rate, train)
+        temp = _dropout(kt, temp, rate, train)
         logits = _dense(p["fc_2"], temp, dtype=dt)[..., 0]     # [B, N]
     return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+
+def precompute_attend(
+    params: Params, config: Config, contexts: jnp.ndarray
+) -> jnp.ndarray:
+    """Hoist the context-only half of the attention MLP out of the decode
+    loop.  The reference recomputes fc_{a,1a}(contexts) at every one of the
+    T×beam steps (model.py:262,395-436) although contexts never change
+    during decoding; at inference (no dropout) the term is loop-invariant.
+
+    Returns the 1-layer per-position logits [B, N] or the 2-layer
+    tanh-activated features [B, N, da].
+    """
+    p = params["attend"]
+    dt = jnp.dtype(config.compute_dtype)
+    if config.num_attend_layers == 1:
+        return _dense(p["fc_a"], contexts, dtype=dt)[..., 0]       # [B, N]
+    return _dense(p["fc_1a"], contexts, activation="tanh", dtype=dt)  # [B,N,da]
+
+
+def attend_with_precomputed(
+    params: Params,
+    config: Config,
+    contexts: jnp.ndarray,
+    ctx_proj: jnp.ndarray,
+    output: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Inference-path attention using the hoisted ``ctx_proj``.
+
+    Returns (context [B, D], alpha [B, N]).  With use_pallas_attention the
+    2-layer combine runs as one fused Pallas kernel (add → matvec →
+    softmax → weighted sum in a single VMEM residency).
+    """
+    p = params["attend"]
+    dt = jnp.dtype(config.compute_dtype)
+    if config.num_attend_layers == 1:
+        logits = ctx_proj + _dense(p["fc_b"], output, dtype=dt)     # [B, N]
+        alpha = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        context = (contexts * alpha[..., None]).sum(axis=1)
+        return context, alpha
+
+    t2 = _dense(p["fc_1b"], output, activation="tanh", dtype=dt)    # [B, da]
+    if config.use_pallas_attention:
+        from ..ops import pallas_attention
+
+        # Interpret mode is a test vehicle only — off TPU the XLA branch
+        # below is the fast mathematically-identical fallback.
+        if jax.default_backend() == "tpu" or pallas_attention.FORCE_INTERPRET:
+            return pallas_attention.fused_attend(
+                ctx_proj, t2, p["fc_2"]["kernel"], contexts,
+                compute_dtype=config.compute_dtype,
+                interpret=jax.default_backend() != "tpu",
+            )
+    temp = ctx_proj + t2[:, None, :]
+    logits = _dense(p["fc_2"], temp, dtype=dt)[..., 0]              # [B, N]
+    alpha = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    context = (contexts * alpha[..., None]).sum(axis=1)
+    return context, alpha
 
 
 def decode_logits(
@@ -264,12 +329,17 @@ def decoder_step(
     word: jnp.ndarray,
     train: bool = False,
     rng: Optional[jax.Array] = None,
+    ctx_proj: Optional[jnp.ndarray] = None,
 ) -> Tuple[DecoderState, jnp.ndarray, jnp.ndarray]:
     """One decoder step: attend → embed → LSTM → logits.
 
     Returns (new_state, logits [B, V], alpha [B, N]).  ``state.output`` must
     be the post-dropout h when training, matching the reference where the
     DropoutWrapper's output feeds the next attend (model.py:262,307).
+
+    ctx_proj: hoisted :func:`precompute_attend` output — inference only
+    (training's per-step context dropout invalidates it, so it is ignored
+    when train=True).
     """
     if train:
         k_att, k_in, k_out, k_state, k_dec = jax.random.split(rng, 5)
@@ -277,8 +347,13 @@ def decoder_step(
         k_att = k_in = k_out = k_state = k_dec = None
     ldr = config.lstm_drop_rate
 
-    alpha = attend(params, config, contexts, state.output, train, k_att)
-    context = (contexts * alpha[..., None]).sum(axis=1)          # [B, D]
+    if ctx_proj is not None and not train:
+        context, alpha = attend_with_precomputed(
+            params, config, contexts, ctx_proj, state.output
+        )
+    else:
+        alpha = attend(params, config, contexts, state.output, train, k_att)
+        context = (contexts * alpha[..., None]).sum(axis=1)      # [B, D]
 
     word_embed = params["word_embedding"]["weights"][word]        # [B, E]
 

@@ -35,7 +35,12 @@ import jax
 import jax.numpy as jnp
 
 from ..config import Config
-from ..models.decoder import DecoderState, decoder_step, init_state
+from ..models.decoder import (
+    DecoderState,
+    decoder_step,
+    init_state,
+    precompute_attend,
+)
 
 NEG_INF = -1e30
 # Added to completed-caption scores when ranking them against live partial
@@ -63,6 +68,7 @@ def beam_search(
     beam_size: Optional[int] = None,
     max_len: Optional[int] = None,
     valid_size: Optional[int] = None,
+    hoist_attention: bool = True,
 ) -> BeamResult:
     """Decode captions for a batch of context grids.
 
@@ -73,6 +79,9 @@ def beam_search(
       but a vocabulary built from a small corpus shrinks below that
       (reference vocabulary.py:25-26), leaving trailing logit columns with
       no word — the reference would index past its word list there.
+    hoist_attention: precompute the context half of the attention MLP
+      outside the decode loop (inference-exact; False keeps the
+      step-by-step oracle path for testing).
     """
     K = beam_size or config.beam_size
     T = max_len or config.max_caption_length
@@ -81,6 +90,15 @@ def beam_search(
 
     # one shared context grid per image, flattened to a [B*K] step batch
     ctx_tiled = jnp.broadcast_to(contexts[:, None], (B, K, N, D)).reshape(B * K, N, D)
+
+    # hoist the context half of the attention MLP out of the T×K loop
+    # (loop-invariant at inference; the reference recomputes it every step)
+    proj_tiled = None
+    if hoist_attention:
+        proj = precompute_attend(params, config, contexts)
+        proj_tiled = jnp.broadcast_to(
+            proj[:, None], (B, K) + proj.shape[1:]
+        ).reshape((B * K,) + proj.shape[1:])
 
     state0 = init_state(params, config, contexts, train=False)  # [B, H]
     H = state0.output.shape[-1]
@@ -104,7 +122,8 @@ def beam_search(
          fin_logp, fin_words, fin_len) = carry
 
         new_state, logits, _ = decoder_step(
-            params, config, ctx_tiled, state, last_word.reshape(B * K), train=False
+            params, config, ctx_tiled, state, last_word.reshape(B * K),
+            train=False, ctx_proj=proj_tiled,
         )
         if valid_size is not None and valid_size < V:
             logits = logits.at[:, valid_size:].set(NEG_INF)

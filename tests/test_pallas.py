@@ -1,0 +1,139 @@
+"""Pallas fused attention + hoisted-projection decode paths."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sat_tpu.config import Config
+from sat_tpu.models.decoder import (
+    attend,
+    attend_with_precomputed,
+    init_decoder_params,
+    init_state,
+    precompute_attend,
+)
+from sat_tpu.ops.beam_search import beam_search
+from sat_tpu.ops.pallas_attention import fused_attend, fused_attend_reference
+
+
+def _cfg(**kw):
+    base = dict(
+        image_size=32,
+        vocabulary_size=50,
+        dim_embedding=8,
+        num_lstm_units=8,
+        dim_initialize_layer=8,
+        dim_attend_layer=16,
+        dim_decode_layer=16,
+        max_caption_length=6,
+        compute_dtype="float32",
+    )
+    return Config(**{**base, **kw})
+
+
+def test_fused_attend_matches_reference(rng):
+    B, N, da, D = 3, 17, 16, 24
+    t1 = jnp.asarray(rng.normal(size=(B, N, da)).astype(np.float32))
+    t2 = jnp.asarray(rng.normal(size=(B, da)).astype(np.float32))
+    w2 = jnp.asarray(rng.normal(size=(da, 1)).astype(np.float32))
+    ctx = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+
+    want_ctx, want_alpha = fused_attend_reference(t1, t2, w2, ctx)
+    got_ctx, got_alpha = fused_attend(t1, t2, w2, ctx, interpret=True)
+    np.testing.assert_allclose(got_alpha, want_alpha, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(got_ctx, want_ctx, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_alpha).sum(-1), 1.0, rtol=1e-6)
+
+
+@pytest.mark.parametrize("layers", [1, 2])
+def test_precomputed_attend_matches_plain(rng, layers):
+    """Hoisting the context projection must be numerically exact in fp32."""
+    config = _cfg(num_attend_layers=layers)
+    params = init_decoder_params(jax.random.PRNGKey(0), config)
+    B, N, D = 2, config.num_ctx, config.dim_ctx
+    contexts = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+    output = jnp.asarray(
+        rng.normal(size=(B, config.num_lstm_units)).astype(np.float32)
+    )
+
+    alpha_plain = attend(params, config, contexts, output, train=False)
+    ctx_plain = (contexts * alpha_plain[..., None]).sum(axis=1)
+
+    proj = precompute_attend(params, config, contexts)
+    ctx_fast, alpha_fast = attend_with_precomputed(
+        params, config, contexts, proj, output
+    )
+    np.testing.assert_allclose(alpha_fast, alpha_plain, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(ctx_fast, ctx_plain, rtol=1e-5, atol=1e-6)
+
+
+def test_beam_search_hoisted_matches_per_step_oracle(rng):
+    """Hoisting the attention projection out of the decode loop must not
+    change the search at all (fp32: identical op sequence per step)."""
+    config = _cfg(beam_size=3)
+    params = init_decoder_params(jax.random.PRNGKey(1), config)
+    B, N, D = 2, config.num_ctx, config.dim_ctx
+    contexts = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+
+    fast = beam_search(params, config, contexts, eos_id=7, hoist_attention=True)
+    oracle = beam_search(
+        params, config, contexts, eos_id=7, hoist_attention=False
+    )
+    np.testing.assert_array_equal(np.asarray(fast.words), np.asarray(oracle.words))
+    np.testing.assert_allclose(
+        np.asarray(fast.log_scores), np.asarray(oracle.log_scores),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_beam_search_pallas_kernel_matches_xla(rng, monkeypatch):
+    """The interpret-mode Pallas decode produces the same captions as the
+    XLA combine (exercises the kernel through the full search off-TPU)."""
+    from sat_tpu.ops import pallas_attention
+
+    config = _cfg(beam_size=3, use_pallas_attention=True)
+    params = init_decoder_params(jax.random.PRNGKey(1), config)
+    B, N, D = 2, config.num_ctx, config.dim_ctx
+    contexts = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+
+    base = beam_search(
+        params, config.replace(use_pallas_attention=False), contexts, eos_id=7
+    )
+    monkeypatch.setattr(pallas_attention, "FORCE_INTERPRET", True)
+    out = beam_search(params, config, contexts, eos_id=7)
+    np.testing.assert_array_equal(np.asarray(out.words), np.asarray(base.words))
+    np.testing.assert_allclose(
+        np.asarray(out.log_scores), np.asarray(base.log_scores),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_fused_attend_bf16_scoring_matches_oracle(rng):
+    """compute_dtype='bfloat16' must use bf16 for the scoring matmul in
+    both the kernel and the oracle — the default-config path."""
+    B, N, da, D = 2, 20, 16, 24
+    t1 = jnp.asarray(rng.normal(size=(B, N, da)).astype(np.float32))
+    t2 = jnp.asarray(rng.normal(size=(B, da)).astype(np.float32))
+    w2 = jnp.asarray(rng.normal(size=(da, 1)).astype(np.float32))
+    ctx = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+
+    want_ctx, want_alpha = fused_attend_reference(
+        t1, t2, w2, ctx, compute_dtype="bfloat16"
+    )
+    got_ctx, got_alpha = fused_attend(
+        t1, t2, w2, ctx, compute_dtype="bfloat16", interpret=True
+    )
+    # bf16 scoring: kernel and XLA round at slightly different points, so
+    # agreement is at bf16-rounding scale, not exact
+    np.testing.assert_allclose(got_alpha, want_alpha, rtol=5e-2, atol=5e-3)
+    np.testing.assert_allclose(got_ctx, want_ctx, rtol=5e-2, atol=5e-2)
+
+    # and the bf16 kernel must be far closer to the bf16 oracle than the
+    # fp32 oracle is (i.e. the dtype knob actually changes the matmul)
+    fp32_ctx, fp32_alpha = fused_attend_reference(
+        t1, t2, w2, ctx, compute_dtype="float32"
+    )
+    assert float(jnp.abs(got_alpha - want_alpha).max()) < float(
+        jnp.abs(fp32_alpha - want_alpha).max()
+    )
