@@ -97,6 +97,12 @@ def build_config(argv: Optional[List[str]] = None):
 def main(argv: Optional[List[str]] = None) -> int:
     config, cli = build_config(argv)
 
+    # multi-host bootstrap first, before any other jax use (no-op unless a
+    # launcher/env signals a cluster — see parallel.mesh)
+    from .parallel import initialize_distributed
+
+    initialize_distributed()
+
     from . import runtime
 
     if config.phase == "train":

@@ -55,9 +55,9 @@ def all_gather_batch(x: jax.Array) -> np.ndarray:
 
     Resharding to replicated via device_put (no per-call jit compile);
     covers multi-host arrays whose shards are not all addressable."""
-    if not x.is_fully_addressable:
-        x = jax.device_put(x, NamedSharding(x.sharding.mesh, P()))
-    return np.asarray(x)
+    from ..utils.dist import gather_tree_replicated
+
+    return np.asarray(gather_tree_replicated(x))
 
 
 def make_global_batch(mesh: Mesh, local_batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:

@@ -1,0 +1,56 @@
+"""Per-host input sharding for multi-process training.
+
+The reference's distributed mode has every worker read the whole dataset
+and rely on asynchrony to decorrelate (/root/reference/main_distributed.py:
+67-79).  The SPMD design instead gives each host a disjoint slice of the
+global batch: the per-host DataSet below yields ``global_batch /
+process_count`` items per step, and ``make_global_batch`` (collectives.py)
+stitches the host shards into one data-sharded global array.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..data.dataset import DataSet
+
+
+def process_local_dataset(
+    dataset: DataSet,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> DataSet:
+    """Slice a *global* DataSet down to this process's shard.
+
+    Rows ``process_index::process_count`` with a per-host batch size of
+    ``global_batch // process_count``; every host sees the same number of
+    batches so the synchronous step count agrees across the slice.
+    Single-process runs return the dataset unchanged.
+    """
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    if pc == 1:
+        return dataset
+    if dataset.batch_size % pc:
+        raise ValueError(
+            f"global batch {dataset.batch_size} not divisible by "
+            f"{pc} processes"
+        )
+    # Truncate every shard to the common length: unequal shards would give
+    # hosts different num_batches, desynchronizing the SPMD collectives
+    # (one host in the checkpoint all-gather while others are in the
+    # gradient all-reduce ⇒ hang).  Drops at most pc-1 trailing samples.
+    n = (len(dataset.image_ids) // pc) * pc
+    sel = slice(pi, n, pc)
+    return DataSet(
+        dataset.image_ids[sel],
+        dataset.image_files[sel],
+        dataset.batch_size // pc,
+        None if dataset.word_idxs is None else dataset.word_idxs[sel],
+        None if dataset.masks is None else dataset.masks[sel],
+        is_train=dataset.is_train,
+        shuffle=dataset.shuffle,
+        seed=pi,
+    )

@@ -93,13 +93,31 @@ def train(
     dataset: Optional[DataSet] = None,
     seed: int = 0,
 ) -> TrainState:
-    """Epoch × batch training loop (reference base_model.py:39-68)."""
+    """Epoch × batch training loop (reference base_model.py:39-68).
+
+    With ``mesh_shape`` spanning more than one device the same loop runs
+    SPMD: state sharded per the (data, model) placement rules, batches
+    data-sharded, XLA inserting the gradient all-reduce — the synchronous
+    upgrade of the reference's async PS strategy (SURVEY.md §2.13)."""
     if dataset is None:
         dataset = prepare_train_data(config)
     if state is None:
         state = setup_state(config, seed=seed)
 
-    train_step = make_jit_train_step(config)
+    if int(np.prod(config.mesh_shape)) > 1:
+        from .parallel import make_mesh, make_parallel_train_step
+        from .parallel.collectives import make_global_batch
+        from .parallel.data import process_local_dataset
+        from .parallel.sharding import shard_train_state
+
+        mesh = make_mesh(config)
+        state = shard_train_state(state, config, mesh)
+        train_step = make_parallel_train_step(config, mesh)
+        dataset = process_local_dataset(dataset)
+        place_batch = lambda b: make_global_batch(mesh, b)  # noqa: E731
+    else:
+        train_step = make_jit_train_step(config)
+        place_batch = lambda b: b  # noqa: E731
     loader = PrefetchLoader(
         dataset,
         ImageLoader(size=config.image_size),
@@ -126,11 +144,13 @@ def train(
                     profile_stop_step = step + config.profile_num_steps
                 state, metrics = train_step(
                     state,
-                    {
-                        "images": batch["images"],
-                        "word_idxs": batch["word_idxs"],
-                        "masks": batch["masks"],
-                    },
+                    place_batch(
+                        {
+                            "images": batch["images"],
+                            "word_idxs": batch["word_idxs"],
+                            "masks": batch["masks"],
+                        }
+                    ),
                     jax.random.fold_in(root_rng, step),
                 )
                 step = int(state.step)

@@ -35,6 +35,7 @@ import jax
 import numpy as np
 
 from ..config import Config
+from ..utils.dist import gather_tree_replicated
 from ..utils.fileio import atomic_write
 
 # ---------------------------------------------------------------------------
@@ -90,13 +91,17 @@ def _assign_leaves(tree: Any, prefix: str, data: Dict[str, np.ndarray]):
 
 def state_to_flat(state: Any) -> Dict[str, np.ndarray]:
     """TrainState → flat dict.  Optimizer slots live under ``optimizer/`` so
-    the trim tool (reference trim_model.py:14) can drop them by prefix."""
+    the trim tool (reference trim_model.py:14) can drop them by prefix.
+    Works on mesh-sharded states (single- or multi-process): shards held
+    by other hosts are all-gathered first so every process can materialize
+    full values (the distributed save path)."""
     flat: Dict[str, np.ndarray] = {}
     flat.update(flatten_with_names(state.params, "params/"))
     if state.batch_stats:
         flat.update(flatten_with_names(state.batch_stats, "batch_stats/"))
     flat.update(flatten_with_names(state.opt_state, "optimizer/"))
     flat["global_step"] = np.asarray(state.step)
+    flat = gather_tree_replicated(flat)
     # one batched D2H transfer for the whole dict, not one per leaf
     return {k: np.asarray(v) for k, v in jax.device_get(flat).items()}
 
@@ -118,9 +123,18 @@ def save_checkpoint(state: Any, config: Config, save_dir: Optional[str] = None) 
     flat = state_to_flat(state)
     step = int(flat["global_step"])
     path = os.path.join(save_dir, f"{step}.npz")
-    # write through the file object: np.savez(path) silently appends '.npz'
-    atomic_write(path, "wb", lambda f: np.savez(f, **flat))
-    config.replace(global_step=step).save(os.path.join(save_dir, "config.json"))
+    if jax.process_index() == 0:
+        # process 0 writes; other hosts only participated in the gather
+        # (the reference's chief-writes checkpointing, main_distributed.py:64)
+        # write through the file object: np.savez(path) appends '.npz' itself
+        atomic_write(path, "wb", lambda f: np.savez(f, **flat))
+        config.replace(global_step=step).save(
+            os.path.join(save_dir, "config.json")
+        )
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"sat_tpu_ckpt_{step}")
     return path
 
 

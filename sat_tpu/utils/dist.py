@@ -1,0 +1,34 @@
+"""Distributed-array gather helpers (dependency-neutral: importable from
+both the train and parallel layers without cycles)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def gather_tree_replicated(tree: Any) -> Any:
+    """Reshard every non-fully-addressable jax.Array leaf to replicated —
+    one batched ``jax.device_put`` call, so the cross-host gathers (ICI /
+    DCN all-gathers) dispatch together instead of one collective per leaf.
+    Fully-addressable leaves (and plain numpy) pass through untouched."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    picked = [
+        i
+        for i, x in enumerate(leaves)
+        if isinstance(x, jax.Array) and not x.is_fully_addressable
+    ]
+    if picked:
+        gathered = jax.device_put(
+            [leaves[i] for i in picked],
+            [
+                NamedSharding(leaves[i].sharding.mesh, PartitionSpec())
+                for i in picked
+            ],
+        )
+        for i, g in zip(picked, gathered):
+            leaves[i] = g
+    return jax.tree_util.tree_unflatten(treedef, leaves)
