@@ -17,6 +17,10 @@ from .sharding import (
     shard_train_state,
     train_state_shardings,
 )
+from .context import (
+    make_context_parallel_loss,
+    make_context_parallel_train_step,
+)
 from .train import (
     create_parallel_train_state,
     make_parallel_beam_search,
@@ -36,4 +40,6 @@ __all__ = [
     "make_parallel_train_step",
     "create_parallel_train_state",
     "make_parallel_beam_search",
+    "make_context_parallel_loss",
+    "make_context_parallel_train_step",
 ]

@@ -1,0 +1,318 @@
+"""Context parallelism: the attention grid sharded across devices.
+
+The reference's attention always sees the whole 196/49-position context
+grid on one device (/root/reference/model.py:395-436); nothing in its
+design scales past one GPU's memory.  Here the grid's N axis shards over
+the mesh's ``model`` axis and the soft-attention becomes a distributed
+softmax — the same blockwise pattern ring/all-to-all sequence parallelism
+uses for long sequences, applied to the visual context axis:
+
+* each device scores only its local context block (local fc_1a matmul —
+  the dominant FLOPs — runs on 1/cp of the grid);
+* softmax normalizes globally via ``lax.pmax`` (max, stop-gradient) and
+  ``lax.psum`` (denominator) over ICI;
+* the attended context vector is a ``lax.psum`` of local partial sums;
+* LSTM / embedding / vocab-logit compute stays replicated per shard
+  (identical on every member, so no further communication).
+
+Exactness: the distributed softmax is algebraically identical to the
+single-device one; tests pin loss/alpha equality on the CPU mesh.
+
+Dropout under CP: masks on *context-sharded* tensors fold the shard index
+into the key (independent masks per block — matches the iid masks a
+single device would draw); masks on *replicated* tensors use the shared
+key so every shard keeps bitwise-identical activations.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import Config
+from ..models.decoder import (
+    DecoderState,
+    _dense,
+    _dropout,
+    decode_logits,
+    init_state,
+    lstm_step,
+)
+from ..train.step import TrainState, split_trainable
+from ..train.optimizer import make_optimizer
+from ..nn.layers import regularization_loss
+from ..models.captioner import encode
+
+AXIS = "model"  # the mesh axis the context grid shards over
+
+
+def _cp_attend(
+    params,
+    config: Config,
+    ctx_local: jnp.ndarray,
+    output: jnp.ndarray,
+    train: bool,
+    rng: Optional[jax.Array],
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Distributed soft attention.  ctx_local: [B, N_local, D] (this
+    shard's block).  Returns (context [B, D] replicated, alpha_local
+    [B, N_local])."""
+    p = params["attend"]
+    rate = config.fc_drop_rate
+    dt = jnp.dtype(config.compute_dtype)
+    idx = jax.lax.axis_index(AXIS)
+    n_local = ctx_local.shape[1]
+
+    if train:
+        kc, ko, kt = jax.random.split(rng, 3)
+        # context-sharded tensor: per-shard independent mask
+        ctx_in = _dropout(jax.random.fold_in(kc, idx), ctx_local, rate, train)
+        # replicated tensor: shared mask (keeps shards bitwise identical)
+        output = _dropout(ko, output, rate, train)
+    else:
+        ctx_in = ctx_local
+
+    if config.num_attend_layers == 1:
+        logits_local = _dense(p["fc_a"], ctx_in, dtype=dt)[..., 0]  # [B, Nl]
+        # fc_b is position-specific h→N_global; slice this shard's block
+        logits_h = _dense(p["fc_b"], output, dtype=dt)              # [B, Ng]
+        logits_local = logits_local + jax.lax.dynamic_slice_in_dim(
+            logits_h, idx * n_local, n_local, axis=1
+        )
+    else:
+        t1 = _dense(p["fc_1a"], ctx_in, activation="tanh", dtype=dt)   # [B,Nl,da]
+        t2 = _dense(p["fc_1b"], output, activation="tanh", dtype=dt)   # [B,da]
+        temp = t1 + t2[:, None, :]
+        if train:
+            temp = _dropout(jax.random.fold_in(kt, idx), temp, rate, train)
+        logits_local = _dense(p["fc_2"], temp, dtype=dt)[..., 0]       # [B,Nl]
+
+    logits_local = logits_local.astype(jnp.float32)
+    # distributed softmax: global max (stop-grad, like jax.nn.softmax —
+    # via all_gather+max, which is differentiable where pmax is not),
+    # local exp, global denominator
+    m = jax.lax.stop_gradient(
+        jnp.max(
+            jax.lax.all_gather(jnp.max(logits_local, axis=-1), AXIS), axis=0
+        )
+    )                                                                # [B]
+    e = jnp.exp(logits_local - m[:, None])                           # [B,Nl]
+    denom = jax.lax.psum(jnp.sum(e, axis=-1), AXIS)                  # [B]
+    alpha_local = e / denom[:, None]
+
+    # attended context: psum of local partial weighted sums
+    context = jax.lax.psum(
+        (ctx_local * alpha_local[..., None]).sum(axis=1), AXIS
+    )                                                                # [B,D]
+    return context, alpha_local
+
+
+def _cp_decoder_step(
+    params,
+    config: Config,
+    ctx_local: jnp.ndarray,
+    state: DecoderState,
+    word: jnp.ndarray,
+    train: bool,
+    rng: Optional[jax.Array],
+):
+    """decoder_step twin with distributed attention; everything after the
+    attend runs replicated (same values on every context shard)."""
+    if train:
+        k_att, k_in, k_out, k_state, k_dec = jax.random.split(rng, 5)
+    else:
+        k_att = k_in = k_out = k_state = k_dec = None
+    ldr = config.lstm_drop_rate
+
+    context, alpha_local = _cp_attend(
+        params, config, ctx_local, state.output, train, k_att
+    )
+    word_embed = params["word_embedding"]["weights"][word]
+
+    lstm_input = jnp.concatenate([context, word_embed], axis=-1)
+    lstm_input = _dropout(k_in, lstm_input, ldr, train)
+    new_c, new_h = lstm_step(
+        params["lstm"], state.memory, state.recurrent, lstm_input,
+        dtype=jnp.dtype(config.compute_dtype),
+    )
+    emitted = _dropout(k_out, new_h, ldr, train)
+    recurrent_h = _dropout(k_state, new_h, ldr, train)
+
+    expanded = jnp.concatenate([emitted, context, word_embed], axis=-1)
+    logits = decode_logits(params, config, expanded, train, k_dec)
+    new_state = DecoderState(memory=new_c, output=emitted, recurrent=recurrent_h)
+    return new_state, logits, alpha_local
+
+
+def _cp_loss_body(
+    params,
+    config: Config,
+    ctx_local: jnp.ndarray,
+    sentences: jnp.ndarray,
+    masks: jnp.ndarray,
+    rng: Optional[jax.Array],
+    train: bool,
+):
+    """Runs INSIDE shard_map over ('data', 'model').  Batch rows are this
+    data-shard's; ctx_local is this model-shard's context block.  Returns
+    replicated (total_wo_reg, metrics)."""
+    B, T = sentences.shape
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    k_init, k_steps = jax.random.split(rng)
+
+    # init from the GLOBAL mean context: local partial mean + psum
+    n_local = ctx_local.shape[1]
+    cp = jax.lax.psum(1, AXIS)
+    context_mean = jax.lax.psum(ctx_local.mean(axis=1) / cp, AXIS)
+    state = _cp_init_state(params, config, context_mean, train, k_init)
+
+    words_in = jnp.concatenate(
+        [jnp.zeros((B, 1), sentences.dtype), sentences[:, :-1]], axis=1
+    )
+    step_rngs = jax.random.split(k_steps, T)
+
+    def body(state, xs):
+        word_t, rng_t = xs
+        state, logits, alpha_local = _cp_decoder_step(
+            params, config, ctx_local, state, word_t, train, rng_t
+        )
+        return state, (logits, alpha_local)
+
+    _, (logits, alphas_local) = jax.lax.scan(body, state, (words_in.T, step_rngs))
+    logits = logits.transpose(1, 0, 2)           # [B, T, V]
+    alphas_local = alphas_local.transpose(1, 0, 2)  # [B, T, Nl]
+
+    masks = masks.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ce = -jnp.take_along_axis(logp, sentences[..., None], axis=-1)[..., 0]
+    # global normalization: batch is sharded over 'data'
+    ce_sum = jax.lax.psum((ce * masks).sum(), "data")
+    mask_sum = jax.lax.psum(masks.sum(), "data")
+    cross_entropy_loss = ce_sum / mask_sum
+
+    # doubly stochastic attention penalty over the GLOBAL (B_global, N_global)
+    masked = alphas_local * masks[..., None]
+    attentions_local = masked.sum(axis=1)        # [B, Nl]
+    diffs = 1.0 - attentions_local
+    n_global = jax.lax.psum(jnp.float32(n_local), AXIS)
+    b_global = jax.lax.psum(jnp.float32(B), "data")
+    sq = jax.lax.psum(jax.lax.psum(jnp.sum(diffs * diffs), AXIS), "data")
+    attention_loss = config.attention_loss_factor * 0.5 * sq / (
+        b_global * n_global
+    )
+
+    predictions = jnp.argmax(logits, axis=-1)
+    correct = jax.lax.psum(((predictions == sentences) * masks).sum(), "data")
+    accuracy = correct / mask_sum
+
+    total = cross_entropy_loss + attention_loss
+    metrics = {
+        "cross_entropy_loss": cross_entropy_loss,
+        "attention_loss": attention_loss,
+        "accuracy": accuracy,
+    }
+    return total, metrics
+
+
+def _cp_init_state(params, config, context_mean, train, rng):
+    """init_state from an already-reduced global context mean (the mean is
+    computed with a psum outside; the MLP itself is replicated)."""
+    p = params["initialize"]
+    rate = config.fc_drop_rate
+    dt = jnp.dtype(config.compute_dtype)
+    if train:
+        k0, k1, k2 = jax.random.split(rng, 3)
+        context_mean = _dropout(k0, context_mean, rate, train)
+    if config.num_initialize_layers == 1:
+        memory = _dense(p["fc_a"], context_mean, dtype=dt)
+        output = _dense(p["fc_b"], context_mean, dtype=dt)
+    else:
+        ta = _dense(p["fc_a1"], context_mean, activation="tanh", dtype=dt)
+        tb = _dense(p["fc_b1"], context_mean, activation="tanh", dtype=dt)
+        if train:
+            ta = _dropout(k1, ta, rate, train)
+            tb = _dropout(k2, tb, rate, train)
+        memory = _dense(p["fc_a2"], ta, dtype=dt)
+        output = _dense(p["fc_b2"], tb, dtype=dt)
+    return DecoderState(memory=memory, output=output, recurrent=output)
+
+
+def make_context_parallel_loss(config: Config, mesh: Mesh, train: bool = True):
+    """(decoder_params, contexts, sentences, masks, rng) -> (loss, metrics).
+
+    contexts arrive GLOBAL [B, N, D]; shard_map splits batch over 'data'
+    and the context axis over 'model'.  Decoder params replicated (the
+    'model' axis is spent on the context grid here, not vocab TP)."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P("data", AXIS, None), P("data", None), P("data", None), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def f(params, contexts, sentences, masks, rng):
+        return _cp_loss_body(
+            params, config, contexts, sentences, masks, rng, train
+        )
+
+    return f
+
+
+def make_context_parallel_train_step(config: Config, mesh: Mesh):
+    """Full train step with context-parallel decoding: encoder runs
+    data-parallel under GSPMD, the decoder under explicit shard_map CP.
+    State must be replicated (use shard_train_state with a (dp,1) spec or
+    plain create_train_state placed on the mesh)."""
+    optimizer = make_optimizer(config)
+    cp_loss = make_context_parallel_loss(config, mesh, train=True)
+
+    def train_step(state: TrainState, batch: Dict[str, Any], rng: jax.Array):
+        trainable, frozen = split_trainable(state.params, config)
+
+        def loss_fn(trainable_params):
+            params = {**frozen, **trainable_params}
+            variables: Dict[str, Any] = {"params": params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+            contexts, _ = encode(
+                variables, config, batch["images"], config.train_cnn
+            )
+            core, metrics = cp_loss(
+                params["decoder"],
+                contexts,
+                batch["word_idxs"],
+                batch["masks"],
+                rng,
+            )
+            reg = regularization_loss(
+                params,
+                fc_scale=config.fc_kernel_regularizer_scale,
+                conv_scale=config.conv_kernel_regularizer_scale,
+                train_cnn=config.train_cnn,
+            )
+            total = core + reg
+            metrics = dict(metrics)
+            metrics["reg_loss"] = reg
+            metrics["total_loss"] = total
+            return total, metrics
+
+        import optax
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(trainable)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, trainable)
+        new_trainable = optax.apply_updates(trainable, updates)
+        new_state = state._replace(
+            params={**state.params, **new_trainable},
+            opt_state=new_opt_state,
+            step=state.step + 1,
+        )
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return new_state, metrics
+
+    return jax.jit(train_step, donate_argnums=(0,))

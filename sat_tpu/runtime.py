@@ -111,8 +111,23 @@ def train(
         from .parallel.sharding import shard_train_state
 
         mesh = make_mesh(config)
-        state = shard_train_state(state, config, mesh)
-        train_step = make_parallel_train_step(config, mesh)
+        if config.context_parallel > 1:
+            # 'model' axis spent on the context grid (distributed-softmax
+            # attention) instead of vocab TP; params stay replicated
+            from .parallel.context import make_context_parallel_train_step
+
+            if mesh.shape.get("model", 1) != config.context_parallel:
+                raise ValueError(
+                    f"context_parallel={config.context_parallel} requires "
+                    f"mesh 'model' axis of that size, got {dict(mesh.shape)}"
+                )
+            state = shard_train_state(
+                state, config.replace(vocabulary_size=-1), mesh
+            )  # vocab rule disabled → fully replicated placement
+            train_step = make_context_parallel_train_step(config, mesh)
+        else:
+            state = shard_train_state(state, config, mesh)
+            train_step = make_parallel_train_step(config, mesh)
         dataset = process_local_dataset(dataset)
         place_batch = lambda b: make_global_batch(mesh, b)  # noqa: E731
     else:
