@@ -1,4 +1,4 @@
-"""Benchmark: training throughput of the flagship caption model.
+"""Benchmark: training throughput + MFU of the flagship caption model.
 
 Measures steady-state captions/sec of the jitted train step — VGG16
 encoder forward (frozen CNN, the reference's published configuration,
@@ -6,68 +6,144 @@ encoder forward (frozen CNN, the reference's published configuration,
 backward, global-norm clip 5.0, Adam — on whatever single device JAX
 provides (the driver runs this on one real TPU chip).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+and emits it IMMEDIATELY after the first timed window completes (the
+round-1 run was killed at rc=124 with zero output; every stage now logs
+progress to stderr so a timeout still leaves a diagnosable tail).
 
 The reference publishes no throughput numbers (SURVEY.md §6), so
 ``vs_baseline`` is computed against ``published.train_captions_per_sec``
 in BASELINE.json when present (recorded from a prior round), else 1.0.
+
+Env knobs: BENCH_BATCH (default 32), BENCH_STEPS (default 10),
+BENCH_WARMUP (default 2), BENCH_PEAK_TFLOPS (override chip bf16 peak for
+MFU when the device kind is unknown).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
 
+_T0 = time.perf_counter()
+
+
+def log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+# bf16 peak FLOP/s per chip by accelerator generation (public spec sheets;
+# used only to report MFU next to raw throughput).
+_PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5lite": 197.0,   # JAX reports v5e as device_kind "TPU v5 lite"
+    "v5p": 459.0,
+    "v6e": 918.0,
+    "v6lite": 918.0,
+}
+
+
+def _peak_flops(device) -> float | None:
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    for key, tf in _PEAK_TFLOPS.items():
+        if key in kind:
+            return tf * 1e12
+    return None
+
+
+def _program_flops(compiled) -> float | None:
+    """FLOPs/step from XLA's cost analysis of the compiled program."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception as e:  # cost analysis is best-effort on some backends
+        log(f"cost_analysis unavailable: {e!r}")
+        return None
+
 
 def main() -> None:
+    log("importing jax")
     import jax
+
+    # Persistent compilation cache: a re-run (or a driver retry) skips the
+    # 20-40s XLA compile entirely.
+    cache_dir = os.path.join(os.path.dirname(__file__) or ".", ".jax_compile_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:
+        log(f"compilation cache not enabled: {e!r}")
+
     import jax.numpy as jnp
 
     from sat_tpu.config import Config
     from sat_tpu.train.step import create_train_state, make_jit_train_step
 
-    config = Config(batch_size=64)
-    B, T = config.batch_size, config.max_caption_length
+    device = jax.devices()[0]
+    log(f"platform={device.platform} device_kind={getattr(device, 'device_kind', '?')}")
+
+    B = int(os.environ.get("BENCH_BATCH", "32"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    n_steps = int(os.environ.get("BENCH_STEPS", "10"))
+    config = Config(batch_size=B)
+    T = config.max_caption_length
 
     rng = np.random.default_rng(0)
-    batch = {
-        "images": jnp.asarray(rng.normal(size=(B, 224, 224, 3)).astype(np.float32)),
-        "word_idxs": jnp.asarray(
-            rng.integers(0, config.vocabulary_size, size=(B, T)).astype(np.int32)
+    log(f"building host batch B={B} T={T}")
+    host_batch = {
+        "images": rng.normal(size=(B, 224, 224, 3)).astype(np.float32),
+        "word_idxs": rng.integers(0, config.vocabulary_size, size=(B, T)).astype(
+            np.int32
         ),
-        "masks": jnp.asarray(
-            (np.arange(T)[None, :] < rng.integers(8, T + 1, size=(B, 1))).astype(
-                np.float32
-            )
+        "masks": (np.arange(T)[None, :] < rng.integers(8, T + 1, size=(B, 1))).astype(
+            np.float32
         ),
     }
 
+    log("initializing model state")
     state = create_train_state(jax.random.PRNGKey(0), config)
-    train_step = make_jit_train_step(config)
     step_rng = jax.random.PRNGKey(1)
+    log("transferring batch + state to device")
+    batch = jax.device_put(host_batch, device)
+    state = jax.device_put(state, device)
+    jax.block_until_ready((batch, state))
 
-    # Sync barrier: fetch a scalar to host.  (block_until_ready alone does
-    # not actually block on tunneled device platforms.)
-    def sync(metrics):
-        return float(metrics["total_loss"])
+    train_step = make_jit_train_step(config)
+    log("lowering + compiling train step (first compile ~20-40s uncached)")
+    t_c = time.perf_counter()
+    compiled = train_step.lower(state, batch, step_rng).compile()
+    compile_s = time.perf_counter() - t_c
+    log(f"compiled in {compile_s:.1f}s")
+    flops_per_step = _program_flops(compiled)
 
-    # compile + settle
-    for _ in range(2):
-        state, metrics = train_step(state, batch, step_rng)
-    sync(metrics)
+    log(f"warmup x{warmup}")
+    for _ in range(warmup):
+        state, metrics = compiled(state, batch, step_rng)
+        loss = float(metrics["total_loss"])  # hard host sync barrier
+        log(f"warmup step done, loss={loss:.4f}")
 
-    n_steps = int(os.environ.get("BENCH_STEPS", "20"))
+    log(f"timing window x{n_steps}")
     t0 = time.perf_counter()
     for _ in range(n_steps):
-        state, metrics = train_step(state, batch, step_rng)
-    sync(metrics)
+        state, metrics = compiled(state, batch, step_rng)
+    float(metrics["total_loss"])  # sync
     elapsed = time.perf_counter() - t0
 
     captions_per_sec = n_steps * B / elapsed
+    step_ms = 1e3 * elapsed / n_steps
+    log(f"{captions_per_sec:.2f} captions/sec ({step_ms:.1f} ms/step)")
 
     baseline = None
     try:
@@ -77,16 +153,24 @@ def main() -> None:
         pass
     vs_baseline = captions_per_sec / baseline if baseline else 1.0
 
-    print(
-        json.dumps(
-            {
-                "metric": "train_captions_per_sec",
-                "value": round(captions_per_sec, 2),
-                "unit": "captions/sec/chip",
-                "vs_baseline": round(vs_baseline, 3),
-            }
-        )
-    )
+    result = {
+        "metric": "train_captions_per_sec",
+        "value": round(captions_per_sec, 2),
+        "unit": "captions/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "step_time_ms": round(step_ms, 2),
+        "batch_size": B,
+        "compile_s": round(compile_s, 1),
+        "device_kind": getattr(device, "device_kind", device.platform),
+    }
+    peak = _peak_flops(device)
+    if flops_per_step is not None:
+        achieved = flops_per_step * n_steps / elapsed
+        result["tflops_per_sec"] = round(achieved / 1e12, 2)
+        if peak:
+            result["mfu"] = round(achieved / peak, 4)
+    # THE contract line — flushed the moment the first window completes.
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

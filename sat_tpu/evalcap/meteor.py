@@ -1,41 +1,58 @@
-"""METEOR — native reimplementation (no JVM).
+"""METEOR 1.5 — native reimplementation (no JVM).
 
 The reference wraps the external ``meteor-1.5.jar`` as a persistent Java
 subprocess speaking a line protocol
 (/root/reference/utils/coco/pycocoevalcap/meteor/meteor.py:15-58); the jar
 itself is not even shipped (.MISSING_LARGE_BLOBS).  This module implements
-the METEOR algorithm (Denkowski & Lavie 2014) directly in Python with a
-C++-accelerated aligner hook (see native/), removing the JVM dependency:
+METEOR 1.5 semantics (Denkowski & Lavie 2014, "Meteor Universal") directly
+in Python with a C++-accelerated twin (see native/):
 
-* stage-wise alignment: exact match (weight 1.0) then Porter-stem match
-  (weight 0.6, the METEOR 1.3 matcher weights), each stage pairing each
-  hypothesis word with its nearest unmatched reference occurrence;
-* the classic METEOR scoring (Banerjee & Lavie 2005): weighted
-  P = m_w/|hyp|, R = m_w/|ref|, Fmean = P·R/(α·P+(1-α)·R) with α=0.9,
-  fragmentation penalty γ·(chunks/matches)^β with γ=0.5, β=3 — identical
-  sentences score ≈1, scrambled ones are penalized;
-* multi-reference: max score over references (jar behavior).
+* stage-wise alignment with the 1.5 English matcher stages and weights —
+  exact 1.0, Porter-stem 0.6, synonym 0.8 — each stage pairing each
+  unmatched hypothesis word with its nearest unmatched reference
+  occurrence (a chunk-minimizing greedy stand-in for the jar's beam
+  aligner);
+* the 1.5 scoring with the English rank-tuned parameters α=0.85, β=0.2,
+  γ=0.6, δ=0.75: content/function-word-discounted weighted precision and
+  recall, Fmean = P·R/(α·P+(1−α)·R), fragmentation penalty
+  γ·(chunks/matches)^β applied only when the alignment has more than one
+  chunk (so an exact hypothesis scores exactly 1.0, matching the jar's
+  behavior on identical inputs);
+* multi-reference: score against every reference, keep the max (jar
+  behavior).
 
-Known divergence from the jar: the WordNet-synonym and paraphrase-table
-stages are omitted (those data files are external to the reference too)
-and the 1.5 rank-tuned parameters are not reproduced, which shifts
-absolute scores slightly; rankings track closely.
+Known divergences from the jar, quantified in tests/test_evalcap.py:
+* the paraphrase-table stage (weight 0.6) is omitted — the table is an
+  80MB external download the reference also never shipped; captions that
+  match only via multi-word paraphrases lose that fractional credit;
+* the synonym stage uses the compact bundled table in meteor_data.py
+  instead of full WordNet (unavailable offline), and the function-word
+  list is curated rather than frequency-derived — pairs outside those
+  tables fall back to exact/stem matching, biasing scores slightly LOW
+  relative to the jar, never high.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-ALPHA = 0.9
-BETA = 3.0
-GAMMA = 0.5
+from .meteor_data import FUNCTION_WORDS, build_synonym_index
+
+# METEOR 1.5 English (rank-tuned) parameters — Denkowski & Lavie 2014,
+# Table 1 (the jar's `-l en` defaults, reference meteor.py:18-19).
+ALPHA = 0.85
+BETA = 0.2
+GAMMA = 0.6
+DELTA = 0.75
 
 EXACT_WEIGHT = 1.0
 STEM_WEIGHT = 0.6
+SYNONYM_WEIGHT = 0.8
 
 _stemmer = None
+_syn_index: Optional[Dict[str, Set[int]]] = None
 
 
 def _stem(word: str) -> str:
@@ -55,6 +72,13 @@ def _stem(word: str) -> str:
     return word
 
 
+def _synonyms() -> Dict[str, Set[int]]:
+    global _syn_index
+    if _syn_index is None:
+        _syn_index = build_synonym_index()
+    return _syn_index
+
+
 def align(hyp: Sequence[str], ref: Sequence[str]) -> List[Tuple[int, int, float]]:
     """Stage-wise greedy alignment returning (hyp_idx, ref_idx, weight).
 
@@ -66,7 +90,7 @@ def align(hyp: Sequence[str], ref: Sequence[str]) -> List[Tuple[int, int, float]
     hyp_used = [False] * len(hyp)
     ref_used = [False] * len(ref)
 
-    def run_stage(key_fn, weight):
+    def run_key_stage(key_fn, weight):
         ref_slots: Dict[str, List[int]] = {}
         for j, w in enumerate(ref):
             if not ref_used[j]:
@@ -83,8 +107,29 @@ def align(hyp: Sequence[str], ref: Sequence[str]) -> List[Tuple[int, int, float]
             hyp_used[i], ref_used[j] = True, True
             matches.append((i, j, weight))
 
-    run_stage(lambda w: w, EXACT_WEIGHT)
-    run_stage(_stem, STEM_WEIGHT)
+    run_key_stage(lambda w: w, EXACT_WEIGHT)
+    run_key_stage(_stem, STEM_WEIGHT)
+
+    # synonym stage: pairwise group-intersection test (not a single key)
+    syn = _synonyms()
+    for i, w in enumerate(hyp):
+        if hyp_used[i]:
+            continue
+        gids = syn.get(w)
+        if not gids:
+            continue
+        best_j = -1
+        for j, r in enumerate(ref):
+            if ref_used[j]:
+                continue
+            rgids = syn.get(r)
+            if rgids and (gids & rgids):
+                if best_j < 0 or abs(j - i) < abs(best_j - i):
+                    best_j = j
+        if best_j >= 0:
+            hyp_used[i], ref_used[best_j] = True, True
+            matches.append((i, best_j, SYNONYM_WEIGHT))
+
     return sorted(matches)
 
 
@@ -99,15 +144,38 @@ def _chunks(matches: List[Tuple[int, int, float]]) -> int:
     return chunks
 
 
+def _weighted_split(
+    words: Sequence[str], matched: Dict[int, float]
+) -> Tuple[float, float]:
+    """(Σ w over matched content words, Σ w over matched function words)."""
+    wc = wf = 0.0
+    for idx, w in matched.items():
+        if words[idx] in FUNCTION_WORDS:
+            wf += w
+        else:
+            wc += w
+    return wc, wf
+
+
+def _side_score(words: Sequence[str], matched: Dict[int, float]) -> float:
+    """δ-discounted weighted match fraction for one side (P or R)."""
+    n_f = sum(1 for w in words if w in FUNCTION_WORDS)
+    n_c = len(words) - n_f
+    denom = DELTA * n_c + (1.0 - DELTA) * n_f
+    if denom == 0:
+        return 0.0
+    wc, wf = _weighted_split(words, matched)
+    return (DELTA * wc + (1.0 - DELTA) * wf) / denom
+
+
 def segment_stats(hypothesis: str, reference: str) -> Dict[str, float]:
     hyp, ref = hypothesis.split(), reference.split()
     matches = align(hyp, ref)
-    weighted = sum(w for _, _, w in matches)
     return {
         "matches": float(len(matches)),
         "chunks": float(_chunks(matches)),
-        "wm_h": weighted,
-        "wm_r": weighted,
+        "p": _side_score(hyp, {i: w for i, _, w in matches}),
+        "r": _side_score(ref, {j: w for _, j, w in matches}),
         "len_h": float(len(hyp)),
         "len_r": float(len(ref)),
     }
@@ -116,13 +184,15 @@ def segment_stats(hypothesis: str, reference: str) -> Dict[str, float]:
 def score_from_stats(s: Dict[str, float]) -> float:
     if s["matches"] == 0 or s["len_h"] == 0 or s["len_r"] == 0:
         return 0.0
-    p = s["wm_h"] / s["len_h"]
-    r = s["wm_r"] / s["len_r"]
+    p, r = s["p"], s["r"]
     if p == 0 or r == 0:
         return 0.0
     fmean = (p * r) / (ALPHA * p + (1 - ALPHA) * r)
-    frag = s["chunks"] / s["matches"]
-    penalty = GAMMA * (frag**BETA)
+    # single-chunk alignments carry no fragmentation penalty (jar
+    # behavior: identical sentences score exactly 1.0)
+    if s["chunks"] <= 1:
+        return fmean
+    penalty = GAMMA * ((s["chunks"] / s["matches"]) ** BETA)
     return fmean * (1.0 - penalty)
 
 

@@ -32,6 +32,9 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_attempted = False
 
 
+ABI_VERSION = 2  # must match sat_native_abi_version() in api.cc
+
+
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sat_tokenize.restype = ctypes.c_void_p
     lib.sat_tokenize.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
@@ -47,18 +50,37 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.sat_free.restype = None
     lib.sat_free.argtypes = [ctypes.c_void_p]
+    lib.sat_meteor_set_data.restype = None
+    lib.sat_meteor_set_data.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    _push_meteor_data(lib)
     return lib
 
 
-def build(force: bool = False) -> bool:
-    """Compile libsat_native.so via make.  Returns success."""
-    if force:
-        subprocess.run(
-            ["make", "-C", _HERE, "clean"], capture_output=True, check=False
-        )
-    result = subprocess.run(
-        ["make", "-C", _HERE], capture_output=True, text=True, check=False
+def _push_meteor_data(lib: ctypes.CDLL) -> None:
+    """Install the METEOR 1.5 function-word / synonym tables (single
+    source of truth: sat_tpu/evalcap/meteor_data.py)."""
+    from ..evalcap.meteor_data import FUNCTION_WORDS, SYNONYM_GROUPS
+
+    lib.sat_meteor_set_data(
+        " ".join(sorted(FUNCTION_WORDS)).encode("utf-8"),
+        "\n".join(" ".join(g) for g in SYNONYM_GROUPS).encode("utf-8"),
     )
+
+
+def build(force: bool = False) -> bool:
+    """Compile libsat_native.so via make.  Returns success; False (not an
+    exception) when no toolchain is present, so a prebuilt .so still loads
+    on machines without a compiler."""
+    try:
+        if force:
+            subprocess.run(
+                ["make", "-C", _HERE, "clean"], capture_output=True, check=False
+            )
+        result = subprocess.run(
+            ["make", "-C", _HERE], capture_output=True, text=True, check=False
+        )
+    except OSError:
+        return False
     if result.returncode != 0:
         return False
     return os.path.exists(_LIB_PATH)
@@ -74,11 +96,43 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return _lib
         _lib_attempted = True
         try:
-            if not os.path.exists(_LIB_PATH):
-                if not build():
+            # make is an mtime no-op when the .so is fresh; this picks up
+            # source edits without a manual clean (and returns False — no
+            # exception — when there is no toolchain, so a prebuilt .so
+            # still loads)
+            if not build() and not os.path.exists(_LIB_PATH):
+                return None
+            lib = ctypes.CDLL(_LIB_PATH)
+            # Stale .so from an older ABI (e.g. a checked-out build
+            # artifact newer than the sources, which make won't touch):
+            # rebuild, then load under a COPY with a fresh path+inode —
+            # re-dlopening the original path would hand back the
+            # already-mapped old library.
+            if (
+                not hasattr(lib, "sat_native_abi_version")
+                or lib.sat_native_abi_version() != ABI_VERSION
+            ):
+                if not build(force=True):
                     return None
-            _lib = _configure(ctypes.CDLL(_LIB_PATH))
-        except OSError:
+                import shutil
+                import tempfile
+
+                fd, tmp = tempfile.mkstemp(
+                    prefix="libsat_native_", suffix=".so", dir=_HERE
+                )
+                os.close(fd)
+                try:
+                    shutil.copy2(_LIB_PATH, tmp)
+                    lib = ctypes.CDLL(tmp)
+                finally:
+                    os.unlink(tmp)  # POSIX: the mapping outlives the unlink
+                if (
+                    not hasattr(lib, "sat_native_abi_version")
+                    or lib.sat_native_abi_version() != ABI_VERSION
+                ):
+                    return None
+            _lib = _configure(lib)
+        except (OSError, AttributeError):
             _lib = None
         return _lib
 

@@ -1,18 +1,25 @@
-// METEOR segment scorer — native replacement for the reference's
+// METEOR 1.5 segment scorer — native replacement for the reference's
 // persistent meteor-1.5.jar subprocess (/root/reference/utils/coco/
 // pycocoevalcap/meteor/meteor.py:15-58).
 //
 // Mirror of the Python implementation in sat_tpu/evalcap/meteor.py
-// (golden-tested against it): stage-wise greedy alignment — exact match
-// (weight 1.0) then Porter-stem match (weight 0.6) with
-// nearest-occurrence pairing — and classic METEOR scoring with α=0.9,
-// β=3, γ=0.5 fragmentation penalty; multi-reference takes the max.
+// (golden-tested against it): stage-wise greedy alignment — exact (1.0),
+// Porter-stem (0.6), synonym (0.8) with nearest-occurrence pairing — and
+// METEOR 1.5 scoring with the English rank-tuned parameters α=0.85,
+// β=0.2, γ=0.6, δ=0.75 (Denkowski & Lavie 2014): content/function-word
+// discounted P and R, fragmentation penalty only when the alignment has
+// more than one chunk.  The function-word and synonym tables are pushed
+// in from Python (meteor_data.py) via sat_meteor_set_data so both
+// backends share one source of truth.
 
 #include <algorithm>
 #include <cmath>
 #include <cstdlib>
 #include <map>
+#include <sstream>
 #include <string>
+#include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace sat_native {
@@ -21,11 +28,17 @@ std::string porter_stem(const std::string& input);
 
 namespace {
 
-constexpr double kAlpha = 0.9;
-constexpr double kBeta = 3.0;
-constexpr double kGamma = 0.5;
+constexpr double kAlpha = 0.85;
+constexpr double kBeta = 0.2;
+constexpr double kGamma = 0.6;
+constexpr double kDelta = 0.75;
 constexpr double kExactWeight = 1.0;
 constexpr double kStemWeight = 0.6;
+constexpr double kSynonymWeight = 0.8;
+
+std::unordered_set<std::string> g_function_words;
+// word -> group ids (two words are synonyms iff their id sets intersect)
+std::unordered_map<std::string, std::vector<int>> g_synonyms;
 
 std::vector<std::string> split_ws(const std::string& s) {
   std::vector<std::string> out;
@@ -45,10 +58,10 @@ struct Match {
   double weight;
 };
 
-void run_stage(const std::vector<std::string>& hyp_keys,
-               const std::vector<std::string>& ref_keys,
-               std::vector<bool>* hyp_used, std::vector<bool>* ref_used,
-               double weight, std::vector<Match>* matches) {
+void run_key_stage(const std::vector<std::string>& hyp_keys,
+                   const std::vector<std::string>& ref_keys,
+                   std::vector<bool>* hyp_used, std::vector<bool>* ref_used,
+                   double weight, std::vector<Match>* matches) {
   std::map<std::string, std::vector<int>> ref_slots;
   for (int j = 0; j < static_cast<int>(ref_keys.size()); j++) {
     if (!(*ref_used)[j]) ref_slots[ref_keys[j]].push_back(j);
@@ -70,7 +83,77 @@ void run_stage(const std::vector<std::string>& hyp_keys,
   }
 }
 
+bool share_group(const std::vector<int>& a, const std::vector<int>& b) {
+  for (int ga : a)
+    for (int gb : b)
+      if (ga == gb) return true;
+  return false;
+}
+
+void run_synonym_stage(const std::vector<std::string>& hyp,
+                       const std::vector<std::string>& ref,
+                       std::vector<bool>* hyp_used,
+                       std::vector<bool>* ref_used,
+                       std::vector<Match>* matches) {
+  for (int i = 0; i < static_cast<int>(hyp.size()); i++) {
+    if ((*hyp_used)[i]) continue;
+    auto hit = g_synonyms.find(hyp[i]);
+    if (hit == g_synonyms.end()) continue;
+    int best_j = -1;
+    for (int j = 0; j < static_cast<int>(ref.size()); j++) {
+      if ((*ref_used)[j]) continue;
+      auto rit = g_synonyms.find(ref[j]);
+      if (rit == g_synonyms.end()) continue;
+      if (share_group(hit->second, rit->second)) {
+        if (best_j < 0 || std::abs(j - i) < std::abs(best_j - i)) best_j = j;
+      }
+    }
+    if (best_j >= 0) {
+      (*hyp_used)[i] = true;
+      (*ref_used)[best_j] = true;
+      matches->push_back({i, best_j, kSynonymWeight});
+    }
+  }
+}
+
+// δ-discounted weighted match fraction for one side (P or R).
+// side_idx: 0 = use hyp_idx, 1 = use ref_idx.
+double side_score(const std::vector<std::string>& words,
+                  const std::vector<Match>& matches, int side_idx) {
+  int n_f = 0;
+  for (const auto& w : words)
+    if (g_function_words.count(w)) n_f++;
+  int n_c = static_cast<int>(words.size()) - n_f;
+  double denom = kDelta * n_c + (1.0 - kDelta) * n_f;
+  if (denom == 0.0) return 0.0;
+  double wc = 0.0, wf = 0.0;
+  for (const auto& m : matches) {
+    int idx = side_idx == 0 ? m.hyp_idx : m.ref_idx;
+    if (g_function_words.count(words[idx]))
+      wf += m.weight;
+    else
+      wc += m.weight;
+  }
+  return (kDelta * wc + (1.0 - kDelta) * wf) / denom;
+}
+
 }  // namespace
+
+void meteor_set_data(const std::string& function_words,
+                     const std::string& synset_lines) {
+  g_function_words.clear();
+  for (const auto& w : split_ws(function_words)) g_function_words.insert(w);
+  g_synonyms.clear();
+  std::istringstream in(synset_lines);
+  std::string line;
+  int gid = 0;
+  while (std::getline(in, line)) {
+    auto words = split_ws(line);
+    if (words.empty()) continue;
+    for (const auto& w : words) g_synonyms[w].push_back(gid);
+    gid++;
+  }
+}
 
 double meteor_segment(const std::string& hypothesis,
                       const std::string& reference) {
@@ -80,13 +163,15 @@ double meteor_segment(const std::string& hypothesis,
 
   std::vector<bool> hyp_used(hyp.size(), false), ref_used(ref.size(), false);
   std::vector<Match> matches;
-  run_stage(hyp, ref, &hyp_used, &ref_used, kExactWeight, &matches);
+  run_key_stage(hyp, ref, &hyp_used, &ref_used, kExactWeight, &matches);
 
   std::vector<std::string> hyp_stems(hyp.size()), ref_stems(ref.size());
   for (size_t i = 0; i < hyp.size(); i++) hyp_stems[i] = porter_stem(hyp[i]);
   for (size_t j = 0; j < ref.size(); j++) ref_stems[j] = porter_stem(ref[j]);
-  run_stage(hyp_stems, ref_stems, &hyp_used, &ref_used, kStemWeight,
-            &matches);
+  run_key_stage(hyp_stems, ref_stems, &hyp_used, &ref_used, kStemWeight,
+                &matches);
+
+  run_synonym_stage(hyp, ref, &hyp_used, &ref_used, &matches);
 
   if (matches.empty()) return 0.0;
   std::sort(matches.begin(), matches.end(),
@@ -95,8 +180,6 @@ double meteor_segment(const std::string& hypothesis,
                                             : a.ref_idx < b.ref_idx;
             });
 
-  double weighted = 0.0;
-  for (const auto& m : matches) weighted += m.weight;
   int chunks = 1;
   for (size_t k = 1; k < matches.size(); k++) {
     if (!(matches[k].hyp_idx == matches[k - 1].hyp_idx + 1 &&
@@ -105,10 +188,13 @@ double meteor_segment(const std::string& hypothesis,
     }
   }
 
-  double p = weighted / hyp.size();
-  double r = weighted / ref.size();
+  double p = side_score(hyp, matches, 0);
+  double r = side_score(ref, matches, 1);
   if (p == 0.0 || r == 0.0) return 0.0;
   double fmean = (p * r) / (kAlpha * p + (1.0 - kAlpha) * r);
+  // single-chunk alignments carry no fragmentation penalty (jar
+  // behavior: identical sentences score exactly 1.0)
+  if (chunks <= 1) return fmean;
   double frag = static_cast<double>(chunks) / matches.size();
   double penalty = kGamma * std::pow(frag, kBeta);
   return fmean * (1.0 - penalty);

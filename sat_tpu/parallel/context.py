@@ -163,6 +163,12 @@ def _cp_loss_body(
     B, T = sentences.shape
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    # iid dropout across data shards: the rng arrives replicated (in_specs
+    # P()), so without this fold rows i and i+B_local on different 'data'
+    # shards would draw bitwise-identical masks — diverging from the iid
+    # masks a single device draws over the global batch.  (Context-sharded
+    # tensors additionally fold the 'model' shard index at use sites.)
+    rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
     k_init, k_steps = jax.random.split(rng)
 
     # init from the GLOBAL mean context: local partial mean + psum
@@ -280,7 +286,7 @@ def make_context_parallel_train_step(config: Config, mesh: Mesh):
             variables: Dict[str, Any] = {"params": params}
             if state.batch_stats:
                 variables["batch_stats"] = state.batch_stats
-            contexts, _ = encode(
+            contexts, enc_state = encode(
                 variables, config, batch["images"], config.train_cnn
             )
             core, metrics = cp_loss(
@@ -300,15 +306,18 @@ def make_context_parallel_train_step(config: Config, mesh: Mesh):
             metrics = dict(metrics)
             metrics["reg_loss"] = reg
             metrics["total_loss"] = total
-            return total, metrics
+            return total, (metrics, enc_state)
 
         import optax
 
-        grads, metrics = jax.grad(loss_fn, has_aux=True)(trainable)
+        grads, (metrics, enc_state) = jax.grad(loss_fn, has_aux=True)(trainable)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, trainable)
         new_trainable = optax.apply_updates(trainable, updates)
         new_state = state._replace(
             params={**state.params, **new_trainable},
+            # thread BN running stats from the encoder (train_cnn with a BN
+            # backbone), mirroring make_train_step's model_state handling
+            batch_stats=enc_state.get("batch_stats", state.batch_stats),
             opt_state=new_opt_state,
             step=state.step + 1,
         )

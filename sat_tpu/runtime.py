@@ -143,10 +143,14 @@ def train(
 
     profiling = False
     profiled = False
+    # Host-side step counter: fetching int(state.step) every iteration would
+    # block the host on the just-dispatched device step, serializing the loop
+    # with the device and defeating async dispatch + prefetch.  Sync once
+    # here (resume-aware), then count locally; device_get only when logging.
+    step = int(state.step)
     with SummaryWriter(config.summary_dir) as writer:
         for epoch in range(config.num_epochs):
             for batch in loader:
-                step = int(state.step)  # step about to run
                 # >= not ==: a run resumed past profile_start_step still
                 # profiles (once) instead of silently never tracing
                 if (
@@ -168,7 +172,7 @@ def train(
                     ),
                     jax.random.fold_in(root_rng, step),
                 )
-                step = int(state.step)
+                step += 1  # == int(state.step), without a device sync
                 if profiling and step >= profile_stop_step:
                     jax.block_until_ready(state)
                     jax.profiler.stop_trace()
@@ -214,12 +218,60 @@ def decode_dataset(
     if state.batch_stats:
         variables["batch_stats"] = state.batch_stats
 
-    @jax.jit
-    def encode_fn(variables, images):
-        contexts, _ = encode(variables, config, images, train=False)
-        return contexts
-
     eos = _eos_id(vocabulary)
+
+    # Mesh-parallel decoding: encoder + beam search in one jitted program
+    # with the image batch sharded over 'data' — eval/test scale over the
+    # mesh exactly like training does (reference capability:
+    # base_model.py:70-117, which is strictly single-device).
+    if int(np.prod(config.mesh_shape)) > 1:
+        from .parallel import make_mesh
+        from .parallel.collectives import make_global_batch
+        from .parallel.sharding import replicated
+        from .parallel.train import make_parallel_beam_search
+
+        if jax.process_count() > 1:
+            # Multi-host decoding needs per-host dataset slicing plus a
+            # cross-host gather of the (non-fully-addressable) beam
+            # results; until that lands, eval/test on a multi-host mesh
+            # must run single-host (training IS multi-host capable).
+            raise NotImplementedError(
+                "mesh decoding supports single-host meshes only; run "
+                "--phase=eval/test with one process"
+            )
+        mesh = make_mesh(config)
+        dp = mesh.shape.get("data", 1)
+        if config.batch_size % dp != 0:
+            raise ValueError(
+                f"batch_size={config.batch_size} not divisible by the "
+                f"data-axis size {dp} for mesh decoding"
+            )
+        variables = jax.device_put(variables, replicated(mesh))
+        caption_fn = make_parallel_beam_search(
+            config, mesh, eos,
+            beam_size=config.beam_size,
+            valid_size=len(vocabulary.words),
+        )
+
+        def run_batch(batch):
+            images = make_global_batch(mesh, {"images": batch["images"]})
+            return caption_fn(variables, images["images"])
+
+    else:
+
+        @jax.jit
+        def encode_fn(variables, images):
+            contexts, _ = encode(variables, config, images, train=False)
+            return contexts
+
+        def run_batch(batch):
+            contexts = encode_fn(variables, batch["images"])
+            return beam_search_jit(
+                state.params["decoder"], config, contexts, eos,
+                beam_size=config.beam_size,
+                valid_size=len(vocabulary.words),
+            )
+
     loader = PrefetchLoader(
         dataset,
         ImageLoader(size=config.image_size),
@@ -231,12 +283,7 @@ def decode_dataset(
     seen = set()
     emitted = 0
     for batch in loader:
-        contexts = encode_fn(variables, batch["images"])
-        out = beam_search_jit(
-            state.params["decoder"], config, contexts, eos,
-            beam_size=config.beam_size,
-            valid_size=len(vocabulary.words),
-        )
+        out = run_batch(batch)
         words = np.asarray(out.words[:, 0])        # best caption per image
         lengths = np.asarray(out.lengths[:, 0])
         scores = np.asarray(out.log_scores[:, 0])

@@ -18,7 +18,7 @@ import json
 import os
 import struct
 import time
-from typing import Dict, Mapping, Optional
+from typing import Any, Dict, Mapping, Optional
 
 import numpy as np
 
@@ -153,14 +153,24 @@ class SummaryWriter:
 
     def scalars(self, step: int, values: Mapping[str, float]) -> None:
         clean: Dict[str, float] = {}
+        # tfevents can only carry finite floats, but a diverged run must
+        # still leave a trace: non-finite values go to metrics.jsonl as
+        # strings ("nan"/"inf") so the failure is visible post-hoc.
+        record: Dict[str, Any] = {}
         for tag, v in values.items():
             v = float(np.asarray(v))
             if np.isfinite(v):
                 clean[tag] = v
-        if not clean:
+                record[tag] = v
+            else:
+                record[tag] = repr(v)
+        if not record:
             return
-        self._events.write(_frame_record(_encode_event(time.time(), step, clean)))
-        self._jsonl.write(json.dumps({"step": int(step), **clean}) + "\n")
+        if clean:
+            self._events.write(
+                _frame_record(_encode_event(time.time(), step, clean))
+            )
+        self._jsonl.write(json.dumps({"step": int(step), **record}) + "\n")
 
     def variable_stats(
         self, step: int, tree, prefix: str = "params", max_vars: int = 0

@@ -100,6 +100,77 @@ def test_cp_train_step_runs_and_learns(rng):
     assert float(metrics["grad_norm"]) > 0
 
 
+def test_cp_dropout_iid_across_data_shards(rng):
+    """Dropout masks must be iid across 'data' shards (ADVICE r1): feed the
+    SAME rows to both data shards; if both shards drew identical masks the
+    global train-mode CE would equal the one-data-shard CE of those rows.
+    With the data-axis fold_in they must differ."""
+    config = _cfg(mesh_shape=(2, 4))
+    params = init_decoder_params(jax.random.PRNGKey(0), config)
+
+    B, T = 2, config.max_caption_length
+    N, D = config.num_ctx, config.dim_ctx
+    contexts = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+    sentences = jnp.asarray(
+        rng.integers(0, config.vocabulary_size, size=(B, T)).astype(np.int32)
+    )
+    masks = jnp.ones((B, T), jnp.float32)
+    key = jax.random.PRNGKey(7)
+
+    mesh2 = make_mesh(config)  # (2 data, 4 context)
+    dup = lambda x: jnp.concatenate([x, x], axis=0)  # noqa: E731
+    _, m2 = make_context_parallel_loss(config, mesh2, train=True)(
+        params, dup(contexts), dup(sentences), dup(masks), key
+    )
+
+    mesh1 = make_mesh(config.replace(mesh_shape=(1, 4)))
+    _, m1 = make_context_parallel_loss(
+        config.replace(mesh_shape=(1, 4)), mesh1, train=True
+    )(params, contexts, sentences, masks, key)
+
+    # shard 0 of the dup run computes exactly the (1,4)-mesh values, so
+    # equality here would mean shard 1 drew the same dropout masks.
+    assert float(m2["cross_entropy_loss"]) != pytest.approx(
+        float(m1["cross_entropy_loss"]), rel=1e-7
+    )
+
+
+def test_cp_train_step_updates_batch_stats(rng):
+    """train_cnn with a BN backbone under CP must thread the encoder's
+    running statistics into the new state (ADVICE r1)."""
+    # resnet downsamples 32×: image_size 64 → 2×2 = 4 context positions,
+    # matching the 4-way context axis
+    config = _cfg(cnn="resnet50", train_cnn=True, mesh_shape=(2, 4), image_size=64)
+    mesh = make_mesh(config)
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    assert state.batch_stats  # resnet50 has BN state
+    step = make_context_parallel_train_step(config, mesh)
+
+    B, T = 2, config.max_caption_length
+    batch = {
+        "images": jnp.asarray(
+            rng.normal(size=(B, config.image_size, config.image_size, 3)).astype(
+                np.float32
+            )
+        ),
+        "word_idxs": jnp.asarray(
+            rng.integers(0, config.vocabulary_size, size=(B, T)).astype(np.int32)
+        ),
+        "masks": jnp.ones((B, T), jnp.float32),
+    }
+    before = jax.device_get(state.batch_stats)  # donated: snapshot first
+    new_state, metrics = step(state, batch, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["total_loss"]))
+    after = jax.device_get(new_state.batch_stats)
+    changed = any(
+        not np.allclose(b, a)
+        for b, a in zip(
+            jax.tree_util.tree_leaves(before), jax.tree_util.tree_leaves(after)
+        )
+    )
+    assert changed, "encoder BN running stats were not updated"
+
+
 def test_runtime_train_with_context_parallel(coco_fixture, tmp_path):
     """runtime.train dispatches to the CP step when context_parallel>1."""
     from sat_tpu import runtime

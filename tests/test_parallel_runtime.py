@@ -85,6 +85,34 @@ def test_mesh_and_single_device_training_agree(coco_fixture, tmp_path):
     np.testing.assert_allclose(b, a, rtol=5e-2)
 
 
+def test_mesh_eval_matches_single_device(coco_fixture, tmp_path):
+    """decode_dataset routes through make_parallel_beam_search on a mesh;
+    parallel eval must produce the SAME captions and scores as the
+    single-device path end-to-end (VERDICT r1 item 5)."""
+    base = coco_fixture["config"].replace(
+        **{**SMALL_MODEL,
+           "save_dir": str(tmp_path / "models"),
+           "summary_dir": str(tmp_path / "summary"),
+           "eval_result_file": str(tmp_path / "res1.json"),
+           "beam_size": 2}
+    )
+    state = runtime.train(base.replace(mesh_shape=(1, 1)))
+
+    single = runtime.evaluate(base.replace(mesh_shape=(1, 1)), state=state)
+    mesh = runtime.evaluate(
+        base.replace(mesh_shape=(2, 1), eval_result_file=str(tmp_path / "res2.json")),
+        state=state,
+    )
+    assert single.keys() == mesh.keys()
+    for k in single:
+        np.testing.assert_allclose(mesh[k], single[k], rtol=1e-6, err_msg=k)
+
+    import json
+    r1 = {r["image_id"]: r["caption"] for r in json.load(open(tmp_path / "res1.json"))}
+    r2 = {r["image_id"]: r["caption"] for r in json.load(open(tmp_path / "res2.json"))}
+    assert r1 == r2 and len(r1) > 0
+
+
 def test_process_local_dataset_slices_disjointly():
     ids = np.arange(24)
     files = np.array([f"f{i}.jpg" for i in ids])

@@ -148,7 +148,7 @@ def _read_records(path):
 def test_summary_writer_tfevents_roundtrip(tmp_path):
     with SummaryWriter(str(tmp_path)) as w:
         w.scalars(1, {"loss": 2.5, "acc": 0.5})
-        w.scalars(2, {"loss": float("nan"), "acc": 1.0})  # nan dropped
+        w.scalars(2, {"loss": float("nan"), "acc": 1.0})  # nan: jsonl only
 
     event_file = [f for f in os.listdir(tmp_path) if f.startswith("events.out")][0]
     records = _read_records(os.path.join(tmp_path, event_file))
@@ -163,7 +163,9 @@ def test_summary_writer_tfevents_roundtrip(tmp_path):
 
     rows = [json.loads(x) for x in open(tmp_path / "metrics.jsonl")]
     assert rows[0] == {"step": 1, "loss": 2.5, "acc": 0.5}
-    assert rows[1] == {"step": 2, "acc": 1.0}
+    # non-finite values can't enter the tfevents wire format but must
+    # still leave a trace of the divergence in metrics.jsonl (ADVICE r1)
+    assert rows[1] == {"step": 2, "acc": 1.0, "loss": "nan"}
 
 
 def test_eval_sweep_scores_every_checkpoint(trained):
