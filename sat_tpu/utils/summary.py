@@ -8,8 +8,9 @@ This module reproduces that capability with zero TensorFlow: a
 ``SummaryWriter`` that emits the TFRecord/Event wire format directly
 (varint-encoded protobuf + masked CRC32C framing), so standard TensorBoard
 reads our logs, and mirrors every scalar into a ``metrics.jsonl`` for
-dependency-free analysis.  Histograms are replaced by mean/std/min/max
-scalar families (same diagnostic signal, no histo proto).
+dependency-free analysis.  Per-variable summaries carry both the
+mean/std/min/max scalar family and a true HistogramProto (TensorBoard's
+histogram tab), bucketed with TF's exponential bucket scheme.
 """
 
 from __future__ import annotations
@@ -78,18 +79,97 @@ def _encode_event(
     step: int,
     scalars: Optional[Mapping[str, float]] = None,
     file_version: Optional[str] = None,
+    summary_bytes: Optional[bytes] = None,
 ) -> bytes:
     # Event { double wall_time = 1; int64 step = 2;
     #         string file_version = 3; Summary summary = 5; }
     out = b"\x09" + struct.pack("<d", wall_time) + b"\x10" + _varint(int(step))
     if file_version is not None:
         out += _field_len(3, file_version.encode("utf-8"))
+    summary = summary_bytes or b""
     if scalars:
-        summary = b"".join(
+        summary += b"".join(
             _field_len(1, _encode_value(tag, v)) for tag, v in scalars.items()
         )
+    if summary:
         out += _field_len(5, summary)
     return out
+
+
+# ---------------------------------------------------------------------------
+# HistogramProto — TensorBoard's histogram tab (the reference logs one per
+# trainable variable, /root/reference/model.py:527).  Buckets follow TF's
+# exponential scheme: ±1e-12·1.1^k up to ±1e20, plus 0 and ±float-max, so
+# standard TensorBoard renders our histograms identically.
+# ---------------------------------------------------------------------------
+
+
+def _make_bucket_limits():
+    pos = []
+    v = 1e-12
+    while v < 1e20:
+        pos.append(v)
+        v *= 1.1
+    fmax = float(np.finfo(np.float64).max)
+    return [-fmax] + [-x for x in reversed(pos)] + [0.0] + pos + [fmax]
+
+
+BUCKET_LIMITS = np.asarray(_make_bucket_limits())
+
+
+def _packed_doubles(field: int, values) -> bytes:
+    payload = struct.pack(f"<{len(values)}d", *[float(v) for v in values])
+    return _field_len(field, payload)
+
+
+def _encode_histo(
+    lo: float, hi: float, num: float, total: float, sumsq: float, counts
+) -> bytes:
+    """HistogramProto{min=1,max=2,num=3,sum=4,sum_squares=5,
+    bucket_limit=6,bucket=7} with zero-run trimming (empty leading/trailing
+    buckets dropped, like TF's proto compression)."""
+    counts = np.asarray(counts)
+    nz = np.flatnonzero(counts)
+    if len(nz):
+        s, e = int(nz[0]), int(nz[-1]) + 1
+    else:
+        s, e = 0, 1
+    out = (
+        b"\x09" + struct.pack("<d", float(lo))
+        + b"\x11" + struct.pack("<d", float(hi))
+        + b"\x19" + struct.pack("<d", float(num))
+        + b"\x21" + struct.pack("<d", float(total))
+        + b"\x29" + struct.pack("<d", float(sumsq))
+    )
+    out += _packed_doubles(6, BUCKET_LIMITS[s:e])
+    out += _packed_doubles(7, counts[s:e])
+    return out
+
+
+def _histo_from_array(values) -> bytes:
+    x = np.asarray(values, dtype=np.float64).ravel()
+    # ±inf land in the outermost buckets; NaNs are dropped entirely (from
+    # num/sum/min/max too) so the proto stays internally consistent even
+    # for a diverged run — the case this summary exists to debug.
+    x = x[~np.isnan(x)]
+    x = np.clip(x, BUCKET_LIMITS[0], BUCKET_LIMITS[-1])
+    counts = np.bincount(
+        np.searchsorted(BUCKET_LIMITS, x, side="left"),
+        minlength=len(BUCKET_LIMITS),
+    )
+    return _encode_histo(
+        x.min() if x.size else 0.0,
+        x.max() if x.size else 0.0,
+        x.size,
+        x.sum(),
+        (x * x).sum(),
+        counts,
+    )
+
+
+def _encode_histo_value(tag: str, histo: bytes) -> bytes:
+    # Summary.Value { string tag = 1; HistogramProto histo = 5; }
+    return _field_len(1, tag.encode("utf-8")) + _field_len(5, histo)
 
 
 def _frame_record(payload: bytes) -> bytes:
@@ -103,19 +183,55 @@ def _frame_record(payload: bytes) -> bytes:
 
 
 def _reduce_stats(leaf_list):
-    """On-device (mean, std, min, max) per array; jitted once at module
-    level so periodic variable_stats calls hit the compile cache."""
+    """On-device (mean, std, min, max, sum, sum_sq, bucket_counts) per
+    array; jitted once at module level so periodic variable_stats calls hit
+    the compile cache.  Histogram bucketing happens on device too, so only
+    ~1.5k counts per variable cross to the host — never the full tensor."""
     import jax
 
     global _reduce_stats_jit
     if _reduce_stats_jit is None:
         import jax.numpy as jnp
 
+        # float32 view of TF's float64 bucket edges (x64 is disabled on
+        # TPU); the 1.1 growth factor dwarfs float32 eps so bucket
+        # boundaries stay distinct.  The ±float64-max sentinels exceed the
+        # float32 range, so pin them to ±float32-max — no float32 tensor
+        # value can exceed them, preserving the catch-all semantics.
+        f32max = float(np.finfo(np.float32).max)
+        limits = jnp.asarray(
+            np.clip(BUCKET_LIMITS, -f32max, f32max), dtype=jnp.float32
+        )
+
         @jax.jit
         def reduce_all(leaves):
-            return [
-                (jnp.mean(x), jnp.std(x), jnp.min(x), jnp.max(x)) for x in leaves
-            ]
+            out = []
+            for x in leaves:
+                x = x.astype(jnp.float32)
+                flat = x.ravel()
+                # diverged-run safety, mirroring _histo_from_array: ±inf
+                # clip into the outermost buckets, NaNs drop from counts
+                # AND histo moments (nan*-reductions) so sum(bucket)==num
+                finite = ~jnp.isnan(flat)
+                clipped = jnp.clip(flat, limits[0], limits[-1])  # ±inf → edges
+                idx = jnp.searchsorted(limits, clipped, side="left")
+                counts = jnp.bincount(
+                    jnp.minimum(idx, limits.shape[0] - 1),
+                    weights=finite.astype(jnp.float32),
+                    length=limits.shape[0],
+                )
+                clean = jnp.where(finite, clipped, 0.0)
+                any_f = finite.any()
+                out.append(
+                    (
+                        jnp.mean(x), jnp.std(x), jnp.min(x), jnp.max(x),
+                        jnp.where(any_f, jnp.nanmin(clipped), 0.0),
+                        jnp.where(any_f, jnp.nanmax(clipped), 0.0),
+                        jnp.sum(clean), jnp.sum(clean * clean),
+                        jnp.sum(finite), counts,
+                    )
+                )
+            return out
 
         _reduce_stats_jit = reduce_all
     return _reduce_stats_jit(leaf_list)
@@ -172,12 +288,26 @@ class SummaryWriter:
             )
         self._jsonl.write(json.dumps({"step": int(step), **record}) + "\n")
 
+    def histograms(self, step: int, values: Mapping[str, Any]) -> None:
+        """True HistogramProto summaries (reference model.py:527) for
+        host-side arrays; one event carrying every tag."""
+        summary = b"".join(
+            _field_len(1, _encode_histo_value(tag, _histo_from_array(v)))
+            for tag, v in values.items()
+        )
+        self._events.write(
+            _frame_record(
+                _encode_event(time.time(), step, summary_bytes=summary)
+            )
+        )
+
     def variable_stats(
         self, step: int, tree, prefix: str = "params", max_vars: int = 0
     ) -> None:
-        """Per-variable mean/std/min/max scalars — the reference's
-        variable_summary for every trainable (model.py:516-524).  Arrays
-        are reduced on device before the host transfer."""
+        """Per-variable mean/std/min/max scalars + full histograms — the
+        reference's variable_summary for every trainable
+        (model.py:516-527).  Arrays are reduced and bucketed on device
+        before the host transfer (only scalars + bucket counts move)."""
         import jax
 
         stats = {}
@@ -187,13 +317,23 @@ class SummaryWriter:
 
         arrays = [leaf for _, leaf in leaves]
         reduced = jax.device_get(_reduce_stats(arrays))
-        for (path, _), (mean, std, lo, hi) in zip(leaves, reduced):
+        histo_summary = b""
+        for (path, _), (
+            mean, std, lo, hi, hlo, hhi, total, sumsq, num, counts
+        ) in zip(leaves, reduced):
             name = prefix + "/" + "/".join(str(getattr(e, "key", getattr(e, "name", e))) for e in path)
             stats[f"{name}/mean"] = mean
             stats[f"{name}/std"] = std
             stats[f"{name}/min"] = lo
             stats[f"{name}/max"] = hi
+            histo = _encode_histo(hlo, hhi, num, total, sumsq, counts)
+            histo_summary += _field_len(1, _encode_histo_value(name, histo))
         self.scalars(step, stats)
+        self._events.write(
+            _frame_record(
+                _encode_event(time.time(), step, summary_bytes=histo_summary)
+            )
+        )
 
     def flush(self) -> None:
         self._events.flush()

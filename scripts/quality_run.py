@@ -1,0 +1,229 @@
+"""End-to-end quality-evidence run: train -> checkpoint -> beam-search eval
+-> BLEU/METEOR/ROUGE/CIDEr, on a self-contained fixture corpus.
+
+The reference's north-star is BLEU-4 = 29.5 on COCO val2014
+(/root/reference/README.md:85-89).  This environment has no network access,
+so COCO itself cannot be fetched; this script instead runs the *entire*
+pipeline (data prep -> vocab build -> prefetch-fed jitted training ->
+checkpoint save/restore -> on-device beam search -> PTB tokenize -> four
+scorers) on a procedurally generated caption corpus where each image has a
+distinct, learnable caption.  A model that actually learns drives BLEU-4
+from ~0 to near-saturation; a broken pipeline stays at 0.  Results land in
+RESULTS.md at the repo root.
+
+Usage:  python scripts/quality_run.py  [--steps N] [--out DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+COLORS = ["red", "blue", "green", "yellow", "black", "white", "brown", "orange"]
+ANIMALS = ["cat", "dog", "horse", "bird", "rabbit", "sheep"]
+PLACES = ["park", "beach", "kitchen", "street", "garden", "field", "harbor", "station"]
+
+
+def make_corpus(root: str, num_images: int = 48, image_edge: int = 96):
+    """Procedural COCO-format corpus: image i shows a color-coded pattern and
+    carries two reference captions with identical content words (the learnable
+    target) and one function-word variation (so scoring vs 2 refs is
+    non-degenerate, like real COCO)."""
+    import cv2
+
+    img_dir = os.path.join(root, "images")
+    os.makedirs(img_dir, exist_ok=True)
+    combos = list(itertools.product(range(len(COLORS)), range(len(ANIMALS)), range(len(PLACES))))
+    if num_images > len(combos):
+        raise SystemExit(
+            f"--num-images must be <= {len(combos)} (distinct caption combos)"
+        )
+    rng = np.random.default_rng(0)
+    rng.shuffle(combos)
+
+    images, annotations = [], []
+    for i in range(num_images):
+        ci, ai, pi = combos[i]
+        fname = f"fixture_{i:06d}.jpg"
+        # visually distinctive image: color block keyed to the caption's
+        # color word + unique per-image texture, so the mapping is learnable
+        img = rng.integers(0, 80, (image_edge, image_edge, 3), dtype=np.uint8)
+        hue = np.zeros(3, dtype=np.uint8)
+        hue[ci % 3] = 250 - 20 * (ci // 3)
+        img[: image_edge // 2, :, :] = hue
+        img[image_edge // 2 :, : image_edge // 2, (ai % 3)] = 200
+        img[image_edge // 2 :, image_edge // 2 :, (pi % 3)] = 120
+        cv2.imwrite(os.path.join(img_dir, fname), img)
+        images.append({"id": i + 1, "file_name": fname})
+        color, animal, place = COLORS[ci], ANIMALS[ai], PLACES[pi]
+        caps = [
+            f"a {color} {animal} in the {place}.",
+            f"the {color} {animal} is in the {place}.",
+        ]
+        for j, cap in enumerate(caps):
+            annotations.append({"id": 1000 + 2 * i + j, "image_id": i + 1, "caption": cap})
+
+    caption_file = os.path.join(root, "captions.json")
+    with open(caption_file, "w") as f:
+        json.dump({"images": images, "annotations": annotations}, f)
+    return img_dir, caption_file
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=600, help="target train steps")
+    ap.add_argument("--out", default="runs/quality")
+    ap.add_argument("--num-images", type=int, default=48)
+    ap.add_argument("--batch-size", type=int, default=8)
+    args = ap.parse_args()
+
+    t0 = time.time()
+    root = os.path.abspath(args.out)
+    os.makedirs(root, exist_ok=True)
+    img_dir, caption_file = make_corpus(root, num_images=args.num_images)
+    print(f"[quality +{time.time()-t0:5.1f}s] corpus: {args.num_images} images at {img_dir}")
+
+    from sat_tpu.cli import build_config
+
+    steps_per_epoch = -(-2 * args.num_images // args.batch_size)  # 2 captions/image
+    num_epochs = -(-args.steps // steps_per_epoch)
+    overrides = [
+        f"train_image_dir={img_dir}",
+        f"train_caption_file={caption_file}",
+        f"eval_image_dir={img_dir}",
+        f"eval_caption_file={caption_file}",
+        f"vocabulary_file={root}/vocabulary.csv",
+        f"temp_annotation_file={root}/anns.csv",
+        f"temp_data_file={root}/data.npy",
+        f"save_dir={root}/models",
+        f"summary_dir={root}/summary",
+        f"eval_result_dir={root}/results",
+        f"eval_result_file={root}/results.json",
+        "max_train_ann_num=none",
+        "max_eval_ann_num=none",
+        f"batch_size={args.batch_size}",
+        f"num_epochs={num_epochs}",
+        "vocabulary_size=200",
+        # overfit protocol: mild dropout + slightly hotter Adam so ~600
+        # steps saturate; documented in RESULTS.md
+        "fc_drop_rate=0.1",
+        "lstm_drop_rate=0.1",
+        "initial_learning_rate=0.0003",
+        "save_period=0",
+        "log_every=10",
+    ]
+    set_args = [x for o in overrides for x in ("--set", o)]
+
+    config, _ = build_config(["--phase=train", "--train_cnn"] + set_args)
+
+    import jax
+
+    from sat_tpu import runtime
+
+    device = jax.devices()[0]
+    print(f"[quality +{time.time()-t0:5.1f}s] device: {device.device_kind} ({device.platform})")
+    print(f"[quality +{time.time()-t0:5.1f}s] training {num_epochs} epochs x {steps_per_epoch} steps")
+    state = runtime.train(config)
+    train_s = time.time() - t0
+    print(f"[quality +{train_s:5.1f}s] training done at step {int(state.step)}")
+
+    eval_config, _ = build_config(["--phase=eval", "--beam_size=3"] + set_args)
+    scores = runtime.evaluate(eval_config, state=state)
+    total_s = time.time() - t0
+
+    # ---- loss curve from metrics.jsonl ----
+    curve = []
+    with open(os.path.join(root, "summary", "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if "total_loss" in rec:
+                curve.append((rec["step"], rec["total_loss"]))
+    sampled = curve[:: max(1, len(curve) // 12)]
+    if curve and sampled[-1][0] != curve[-1][0]:
+        sampled.append(curve[-1])
+
+    with open(os.path.join(root, "scores.json"), "w") as f:
+        json.dump(
+            {
+                "scores": scores,
+                "steps": int(state.step),
+                "device": device.device_kind,
+                "train_seconds": round(train_s, 1),
+                "total_seconds": round(total_s, 1),
+                "num_images": args.num_images,
+                "protocol": "overfit-fixture",
+            },
+            f,
+            indent=2,
+        )
+
+    lines = [
+        "# RESULTS — quality evidence (fixture-scale end-to-end run)",
+        "",
+        f"Produced by `python scripts/quality_run.py` on **{device.device_kind}** "
+        f"({device.platform}); total wall-clock {total_s:.0f}s "
+        f"(train {train_s:.0f}s for {int(state.step)} steps, the rest is "
+        "eval-side beam search + scoring + compiles).",
+        "",
+        "**Protocol.** This environment has no network egress, so COCO val2014 "
+        "(the reference's BLEU-4 = 29.5 benchmark, `/root/reference/README.md:85-89`) "
+        "cannot be fetched. Instead this run drives the complete pipeline — COCO-format "
+        "ingestion, vocabulary build, prefetch-fed jitted training of the full "
+        f"VGG16+attention-LSTM model (`--train_cnn`), checkpointing, on-device batched "
+        "beam search (beam=3), PTB tokenization, and all four scorers — on a "
+        f"self-contained {args.num_images}-image corpus where every image carries a "
+        "distinct learnable caption (content words correlated with image pixels). "
+        "The memorization protocol turns caption quality into a pipeline-integrity "
+        "test: a model that learns saturates BLEU; any break in the chain "
+        "(preprocessing, attention, decoding, tokenization, scoring) keeps it near 0.",
+        "",
+        "## Scores (beam_size=3, eval over all corpus images)",
+        "",
+        "| Metric | Score |",
+        "|---|---|",
+    ]
+    for k, v in scores.items():
+        lines.append(f"| {k} | {v:.4f} |")
+    lines += [
+        "",
+        f"Raw artifacts: `runs/quality/scores.json`, `runs/quality/results.json` "
+        "(per-image captions).",
+        "",
+        "## Training loss curve (total_loss from metrics.jsonl)",
+        "",
+        "| Step | Total loss |",
+        "|---|---|",
+    ]
+    for step, loss in sampled:
+        lines.append(f"| {step} | {loss:.3f} |")
+    lines += [
+        "",
+        "## Config deltas vs flagship defaults",
+        "",
+        "`--train_cnn`, `batch_size=8`, `vocabulary_size=200`, "
+        "`fc_drop_rate=0.1`, `lstm_drop_rate=0.1`, `initial_learning_rate=3e-4` "
+        f"(overfit protocol), `num_epochs={num_epochs}`. Everything else — "
+        "VGG16 encoder, 224×224 input, 512-unit attention LSTM, Adam, "
+        "global-norm clip 5.0, doubly-stochastic attention penalty — is the "
+        "reference-published configuration (`/root/reference/config.py:8-43`).",
+        "",
+    ]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo_root, "RESULTS.md"), "w") as f:
+        f.write("\n".join(lines))
+    print(f"[quality +{time.time()-t0:5.1f}s] RESULTS.md written")
+    for k, v in scores.items():
+        print(f"  {k}: {v:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -168,6 +168,117 @@ def test_summary_writer_tfevents_roundtrip(tmp_path):
     assert rows[1] == {"step": 2, "acc": 1.0, "loss": "nan"}
 
 
+def _decode_histo(histo: bytes):
+    """Minimal HistogramProto reader: returns dict of scalar fields plus
+    bucket_limit/bucket arrays."""
+    out = {"bucket_limit": [], "bucket": []}
+    names = {1: "min", 2: "max", 3: "num", 4: "sum", 5: "sum_squares"}
+    i = 0
+    while i < len(histo):
+        key = histo[i]
+        field, wire = key >> 3, key & 7
+        i += 1
+        if wire == 1:
+            (v,) = struct.unpack("<d", histo[i : i + 8])
+            out[names[field]] = v
+            i += 8
+        elif wire == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = histo[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            vals = struct.unpack(f"<{ln // 8}d", histo[i : i + ln])
+            out["bucket_limit" if field == 6 else "bucket"] = list(vals)
+            i += ln
+        else:
+            raise AssertionError(f"unexpected wire type {wire}")
+    return out
+
+
+def test_summary_writer_histograms(tmp_path):
+    values = np.asarray([1.0, -1.0, 0.5, 0.5, 1e6])
+    with SummaryWriter(str(tmp_path)) as w:
+        w.histograms(7, {"weights": values})
+
+    event_file = [f for f in os.listdir(tmp_path) if f.startswith("events.out")][0]
+    records = _read_records(os.path.join(tmp_path, event_file))
+    rec = records[1]
+    assert b"weights" in rec
+    # walk to the histo submessage: Event.summary(5) > Value(1) > histo(5),
+    # each preceded by the tag(1) string "weights"
+    idx = rec.index(b"weights") + len(b"weights")
+    assert rec[idx] == 0x2A  # field 5 (histo), wire type 2
+    i = idx + 1
+    ln = shift = 0
+    while True:  # varint length (histos exceed 127 bytes)
+        b = rec[i]
+        i += 1
+        ln |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            break
+    histo = _decode_histo(rec[i : i + ln])
+    assert histo["num"] == 5
+    assert histo["min"] == -1.0 and histo["max"] == 1e6
+    assert histo["sum"] == pytest.approx(1e6 + 1.0)
+    assert histo["sum_squares"] == pytest.approx(1e12 + 2.5)
+    assert sum(histo["bucket"]) == 5
+    assert len(histo["bucket"]) == len(histo["bucket_limit"])
+    # limits are bucket *upper* edges: the first retained limit is the
+    # upper edge of the bucket holding the min (just above it, within one
+    # 1.1× growth step), and the last covers the max
+    lims = histo["bucket_limit"]
+    assert -1.0 <= lims[0] <= -1.0 / 1.1
+    assert lims[-1] >= 1e6
+
+
+def test_histograms_stay_consistent_under_nonfinite(tmp_path):
+    """A diverged run (NaN/inf values) must still produce a well-formed
+    proto: NaNs dropped everywhere, infs clamped into the edge buckets."""
+    values = np.asarray([np.nan, np.inf, -np.inf, 1.0])
+    with SummaryWriter(str(tmp_path)) as w:
+        w.histograms(1, {"diverged": values})
+    event_file = [f for f in os.listdir(tmp_path) if f.startswith("events.out")][0]
+    rec = _read_records(os.path.join(tmp_path, event_file))[1]
+    idx = rec.index(b"diverged") + len(b"diverged")
+    assert rec[idx] == 0x2A
+    i = idx + 1
+    ln = shift = 0
+    while True:
+        b = rec[i]
+        i += 1
+        ln |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            break
+    histo = _decode_histo(rec[i : i + ln])
+    assert histo["num"] == 3                      # NaN dropped
+    assert sum(histo["bucket"]) == 3              # counts match num
+    assert np.isfinite([histo["min"], histo["max"], histo["sum"]]).all()
+    assert len(histo["bucket"]) == len(histo["bucket_limit"])
+
+
+def test_variable_stats_include_histograms(tmp_path):
+    tree = {"w": np.linspace(-1, 1, 101, dtype=np.float32),
+            "b": np.zeros((4,), dtype=np.float32)}
+    with SummaryWriter(str(tmp_path)) as w:
+        w.variable_stats(3, tree, prefix="params")
+    event_file = [f for f in os.listdir(tmp_path) if f.startswith("events.out")][0]
+    records = _read_records(os.path.join(tmp_path, event_file))
+    # record 1 = scalar stats, record 2 = histograms
+    assert b"params/w/mean" in records[1]
+    histo_rec = records[2]
+    for tag in (b"params/w", b"params/b"):
+        assert tag in histo_rec
+    # num encoded as double 101 for w somewhere in the histo record
+    assert struct.pack("<d", 101.0) in histo_rec
+
+
 def test_eval_sweep_scores_every_checkpoint(trained):
     config, _ = trained
     sweep = runtime.evaluate_sweep(config)
