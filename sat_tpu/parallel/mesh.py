@@ -24,13 +24,28 @@ from jax.sharding import Mesh
 from ..config import Config
 
 
-# Env vars whose presence signals a multi-process launch worth wiring up.
-_MULTIHOST_ENV_SIGNALS = (
-    "JAX_COORDINATOR_ADDRESS",      # explicit JAX bootstrap
-    "TPU_WORKER_HOSTNAMES",         # Cloud TPU pod slice
-    "MEGASCALE_COORDINATOR_ADDRESS",  # multi-slice DCN
-    "SLURM_STEP_NODELIST",          # SLURM launcher
-)
+def _multihost_env_signal() -> bool:
+    """True only when the environment describes an actual multi-process
+    launch.  Presence alone is not enough: single-host setups legitimately
+    export TPU_WORKER_HOSTNAMES=localhost (one entry) or SLURM vars for a
+    one-task allocation, and bootstrapping a coordinator there crashes."""
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):      # explicit bootstrap
+        return True
+    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):  # multi-slice DCN
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")   # Cloud TPU pod
+    if len([h for h in hosts.split(",") if h.strip()]) > 1:
+        return True
+    if os.environ.get("SLURM_STEP_NODELIST"):            # SLURM launcher
+        # srun sets SLURM_STEP_NUM_TASKS (what jax's own SlurmCluster
+        # reads); SLURM_NTASKS only appears when --ntasks was explicit
+        for var in ("SLURM_STEP_NUM_TASKS", "SLURM_NTASKS"):
+            try:
+                return int(os.environ[var]) > 1
+            except (KeyError, ValueError):
+                continue
+        return False
+    return False
 
 
 def initialize_distributed(
@@ -49,8 +64,7 @@ def initialize_distributed(
     (clusterone_config.py:91-93).
     """
     explicit = coordinator_address is not None or num_processes is not None
-    env_signal = any(os.environ.get(k) for k in _MULTIHOST_ENV_SIGNALS)
-    if not explicit and not env_signal:
+    if not explicit and not _multihost_env_signal():
         return False
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

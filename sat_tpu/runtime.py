@@ -34,7 +34,6 @@ from .models.captioner import encode, init_variables
 from .ops.beam_search import beam_search_jit
 from .train.checkpoint import (
     latest_checkpoint,
-    load_pretrained_cnn,
     restore_checkpoint,
     save_checkpoint,
 )
@@ -61,23 +60,26 @@ def setup_state(
     (/root/reference/main.py:49-53)."""
     state = create_train_state(jax.random.PRNGKey(seed), config)
     if load or model_file:
-        state, count = restore_checkpoint(
-            state, model_file=model_file, save_dir=config.save_dir
-        )
+        if model_file and model_file.endswith(".npy"):
+            # a checkpoint written by the *reference* itself (flat TF1
+            # var.name dict, base_model.py:242-249) — imported via the
+            # name-translation path so reference-trained models run here
+            from .train.checkpoint import import_reference_checkpoint
+
+            state, count = import_reference_checkpoint(state, model_file)
+        else:
+            state, count = restore_checkpoint(
+                state, model_file=model_file, save_dir=config.save_dir
+            )
         if count == 0:
             raise ValueError(
                 f"checkpoint {model_file or config.save_dir} restored 0 tensors"
             )
         print(f"{count} tensors loaded from checkpoint (step {int(state.step)}).")
     if load_cnn and cnn_model_file:
-        variables: Dict[str, Any] = {"params": state.params}
-        if state.batch_stats:
-            variables["batch_stats"] = state.batch_stats
-        variables, count = load_pretrained_cnn(variables, cnn_model_file)
-        state = state._replace(
-            params=variables["params"],
-            batch_stats=variables.get("batch_stats", state.batch_stats),
-        )
+        from .train.checkpoint import apply_cnn_import
+
+        state, count = apply_cnn_import(state, cnn_model_file)
         print(f"{count} pretrained CNN tensors loaded.")
     return state
 

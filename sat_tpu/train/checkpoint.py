@@ -225,6 +225,69 @@ def _nested_npy(data_path: str) -> Dict[str, Dict[str, np.ndarray]]:
     return {str(k): {str(p): np.asarray(a) for p, a in v.items()} for k, v in d.items()}
 
 
+def _find_op(tree: Any, op: str) -> Optional[Dict[str, Any]]:
+    """Locate the dict node named ``op`` at any depth — Flax nests block
+    submodules (cnn/res2a/res2a_branch2a/...) one level deeper than the
+    reference's flat TF scopes."""
+    if not isinstance(tree, dict):
+        return None
+    if op in tree and isinstance(tree[op], dict):
+        return tree[op]
+    for child in tree.values():
+        hit = _find_op(child, op)
+        if hit is not None:
+            return hit
+    return None
+
+
+def _set_key(dest: Dict[str, Any], key: str, value: np.ndarray) -> bool:
+    """Assign ``key`` within the op's subtree; our nn.Conv wrapper nests
+    an inner 'conv' module, so descend through child dicts if needed."""
+    if key in dest and not isinstance(dest[key], dict):
+        if tuple(dest[key].shape) != tuple(value.shape):
+            return False
+        dest[key] = value.astype(dest[key].dtype)
+        return True
+    for child in dest.values():
+        if isinstance(child, dict) and _set_key(child, key, value):
+            return True
+    return False
+
+
+def _place_nested(
+    cnn_params: Dict[str, Any],
+    batch_stats: Dict[str, Any],
+    nested: Dict[str, Dict[str, np.ndarray]],
+) -> int:
+    """Place ``{op: {param: arr}}`` entries into the (numpy, mutated
+    in-place) CNN param / batch-stat trees, alias-mapping param names.
+    Unknown ops/params are skipped, matching the reference's
+    ignore_missing=True (base_model.py:295-296).  Returns tensors placed."""
+    count = 0
+
+    def place(tree: Dict[str, Any], op: str, key: str, value: np.ndarray) -> bool:
+        dest = _find_op(tree, op)
+        return dest is not None and _set_key(dest, key, value)
+
+    for op_name, entries in nested.items():
+        for param_name, value in entries.items():
+            if param_name in _KERNEL_NAMES:
+                key, tree = "kernel", cnn_params
+            elif param_name in _SCALE_NAMES:
+                key, tree = "scale", cnn_params
+            elif param_name in _BIAS_NAMES:
+                key, tree = "bias", cnn_params
+            elif param_name in _MEAN_NAMES:
+                key, tree = "mean", batch_stats
+            elif param_name in _VAR_NAMES:
+                key, tree = "var", batch_stats
+            else:
+                continue
+            if place(tree, op_name, key, value):
+                count += 1
+    return count
+
+
 def load_pretrained_cnn(
     variables: Dict[str, Any], data_path: str
 ) -> Tuple[Dict[str, Any], int]:
@@ -234,65 +297,95 @@ def load_pretrained_cnn(
     op names are the TF scopes our Flax modules reuse verbatim (conv1_1 …,
     res2a_branch2a …, bn_conv1 …).  Conv kernels arrive HWIO (TF layout =
     ours).  BN stats land in ``batch_stats``; scale/offset in params.
-    Unknown ops/params are skipped, matching ignore_missing=True
-    (base_model.py:295-296).  Returns (new_variables, tensors_loaded).
+    Returns (new_variables, tensors_loaded).
     """
-    nested = _nested_npy(data_path)
+    return _import_cnn_nested(variables, _nested_npy(data_path))
+
+
+# ---------------------------------------------------------------------------
+# full reference-checkpoint import (TF1 flat-name format)
+# ---------------------------------------------------------------------------
+
+_DECODER_SCOPES = ("word_embedding", "initialize", "attend", "decode")
+
+
+def import_reference_checkpoint(state: Any, path: str) -> Tuple[Any, int]:
+    """Ingest a checkpoint written by the reference's own save():
+    a flat ``{var.name: value}`` npy (base_model.py:242-249).
+
+    Name translation, not weight surgery — the decoder was designed with
+    TF1-compatible layouts so every tensor drops in unchanged:
+
+    * ``<scope>/<fc>/kernel:0`` → ``params/decoder/<scope>/<fc>/kernel``
+      for the word_embedding / initialize / attend / decode scopes
+      (reference model.py:219-225,358-459);
+    * ``lstm/lstm_cell/{kernel,bias}:0`` → ``params/decoder/lstm/*`` —
+      the single concatenated [(D+E+H), 4H] matrix with TF1's (i, j, f, o)
+      gate order, which lstm_step consumes natively (the +1.0 forget bias
+      is a runtime constant on both sides, never stored);
+    * CNN scopes (``conv1_1/kernel:0``, ``res2a_branch2a/...``,
+      BN gamma/beta/moving_mean/moving_variance) place through the same
+      alias machinery as the nested pretrained import;
+    * optimizer slots (``OptimizeLoss/...``) are dropped — the reference's
+      Adam state has no meaning for our optax chain; ``global_step:0``
+      restores the step counter.
+
+    Returns (new_state, tensors_loaded).
+    """
+    raw = np.load(path, allow_pickle=True, encoding="latin1").item()
+
+    decoder_flat: Dict[str, np.ndarray] = {}
+    cnn_nested: Dict[str, Dict[str, np.ndarray]] = {}
+    step: Optional[np.ndarray] = None
+    for name, value in raw.items():
+        name = name.split(":")[0]
+        parts = name.split("/")
+        if parts[0] == "global_step":
+            step = np.asarray(value, dtype=np.int32)
+        elif parts[0].startswith("OptimizeLoss") or "optimizer" in parts[0].lower():
+            continue
+        elif parts[0] == "lstm":
+            decoder_flat[f"params/decoder/lstm/{parts[-1]}"] = np.asarray(value)
+        elif parts[0] in _DECODER_SCOPES:
+            decoder_flat["params/decoder/" + "/".join(parts)] = np.asarray(value)
+        elif len(parts) >= 2:
+            cnn_nested.setdefault(parts[0], {})[parts[-1]] = np.asarray(value)
+
+    params, n_dec = _assign_leaves(state.params, "params/", decoder_flat)
+    new_state, n_cnn = apply_cnn_import(state._replace(params=params), cnn_nested)
+    if step is not None:
+        new_state = new_state._replace(step=step)
+    return new_state, n_dec + n_cnn
+
+
+def apply_cnn_import(state: Any, nested_or_path: Any) -> Tuple[Any, int]:
+    """Import a nested CNN dict (or its npy path) into a TrainState —
+    the variables-wrap/unwrap shared by the reference-checkpoint import
+    and runtime.setup_state's --load_cnn branch."""
+    variables: Dict[str, Any] = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    if isinstance(nested_or_path, str):
+        nested_or_path = _nested_npy(nested_or_path)
+    variables, count = _import_cnn_nested(variables, nested_or_path)
+    return (
+        state._replace(
+            params=variables["params"],
+            batch_stats=variables.get("batch_stats", state.batch_stats),
+        ),
+        count,
+    )
+
+
+def _import_cnn_nested(
+    variables: Dict[str, Any], nested: Dict[str, Dict[str, np.ndarray]]
+) -> Tuple[Dict[str, Any], int]:
+    """load_pretrained_cnn body for an already-loaded nested dict."""
     cnn_params = jax.tree_util.tree_map(np.asarray, variables["params"]["cnn"])
     batch_stats = jax.tree_util.tree_map(
         np.asarray, variables.get("batch_stats", {})
     )
-    count = 0
-
-    def find_op(tree: Any, op: str) -> Optional[Dict[str, Any]]:
-        """Locate the dict node named ``op`` at any depth — Flax nests
-        block submodules (cnn/res2a/res2a_branch2a/...) one level deeper
-        than the reference's flat TF scopes."""
-        if not isinstance(tree, dict):
-            return None
-        if op in tree and isinstance(tree[op], dict):
-            return tree[op]
-        for child in tree.values():
-            hit = find_op(child, op)
-            if hit is not None:
-                return hit
-        return None
-
-    def set_key(dest: Dict[str, Any], key: str, value: np.ndarray) -> bool:
-        """Assign ``key`` within the op's subtree; our nn.Conv wrapper nests
-        an inner 'conv' module, so descend through child dicts if needed."""
-        if key in dest and not isinstance(dest[key], dict):
-            if tuple(dest[key].shape) != tuple(value.shape):
-                return False
-            dest[key] = value.astype(dest[key].dtype)
-            return True
-        for child in dest.values():
-            if isinstance(child, dict) and set_key(child, key, value):
-                return True
-        return False
-
-    def place(tree: Dict[str, Any], op: str, key: str, value: np.ndarray) -> bool:
-        dest = find_op(tree, op)
-        return dest is not None and set_key(dest, key, value)
-
-    for op_name, entries in nested.items():
-        for param_name, value in entries.items():
-            if param_name in _KERNEL_NAMES:
-                keys, trees = ("kernel",), (cnn_params,)
-            elif param_name in _SCALE_NAMES:
-                keys, trees = ("scale",), (cnn_params,)
-            elif param_name in _BIAS_NAMES:
-                keys, trees = ("bias",), (cnn_params,)
-            elif param_name in _MEAN_NAMES:
-                keys, trees = ("mean",), (batch_stats,)
-            elif param_name in _VAR_NAMES:
-                keys, trees = ("var",), (batch_stats,)
-            else:
-                continue
-            for key, tree in zip(keys, trees):
-                if place(tree, op_name, key, value):
-                    count += 1
-
+    count = _place_nested(cnn_params, batch_stats, nested)
     new_variables = dict(variables)
     new_params = dict(variables["params"])
     new_params["cnn"] = cnn_params

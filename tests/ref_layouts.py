@@ -1,0 +1,159 @@
+"""Layout-exact synthetic reference checkpoints.
+
+Generators that replicate — name for name, shape for shape — the three
+external weight formats the reference consumes, so import paths are tested
+against the *real* layouts rather than fixtures derived from our own
+naming:
+
+* ``make_vgg16_no_fc``    — the nested ``{op: {param: arr}}`` caffe-export
+  layout of ``vgg16_no_fc.npy`` (all 13 convs, ``weights``/``biases``
+  param names, HWIO shapes; reference scopes model.py:24-60, loader
+  base_model.py:280-297);
+* ``make_resnet50_no_fc`` — ``resnet50_no_fc.npy``: conv1 + 16 bottleneck
+  blocks' convs (bias-free) + per-conv BN entries with the caffe-style
+  ``mean/variance/scale/offset`` param names (reference scopes
+  model.py:62-188);
+* ``make_reference_train_checkpoint`` — the flat ``{var.name: value}``
+  dict that the reference's own ``save()`` writes (base_model.py:242-249):
+  TF1 variable names with ``:0`` suffixes, ``lstm/lstm_cell/kernel`` as
+  the single concatenated [(D+E+H), 4H] matrix in (i, j, f, o) gate order.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+# (name, out_channels) in reference build order, model.py:32-52
+VGG16_CONVS = [
+    ("conv1_1", 64), ("conv1_2", 64),
+    ("conv2_1", 128), ("conv2_2", 128),
+    ("conv3_1", 256), ("conv3_2", 256), ("conv3_3", 256),
+    ("conv4_1", 512), ("conv4_2", 512), ("conv4_3", 512),
+    ("conv5_1", 512), ("conv5_2", 512), ("conv5_3", 512),
+]
+
+# (stage prefix, bottleneck width, #identity blocks) — model.py:83-100
+RESNET_STAGES = [("2", 64, 2), ("3", 128, 3), ("4", 256, 5), ("5", 512, 2)]
+
+
+def make_vgg16_no_fc(path: str, seed: int = 0) -> Dict[str, Dict[str, np.ndarray]]:
+    rng = np.random.default_rng(seed)
+    nested: Dict[str, Dict[str, np.ndarray]] = {}
+    c_in = 3
+    for name, c_out in VGG16_CONVS:
+        nested[name] = {
+            "weights": rng.normal(0, 0.05, (3, 3, c_in, c_out)).astype(np.float32),
+            "biases": rng.normal(0, 0.01, (c_out,)).astype(np.float32),
+        }
+        c_in = c_out
+    np.save(path, np.array(nested, dtype=object), allow_pickle=True)
+    return nested
+
+
+def _bn_entry(rng, c: int) -> Dict[str, np.ndarray]:
+    return {
+        "mean": rng.normal(0, 0.1, (c,)).astype(np.float32),
+        "variance": rng.uniform(0.5, 1.5, (c,)).astype(np.float32),
+        "scale": rng.uniform(0.9, 1.1, (c,)).astype(np.float32),
+        "offset": rng.normal(0, 0.01, (c,)).astype(np.float32),
+    }
+
+
+def make_resnet50_no_fc(path: str, seed: int = 0) -> Dict[str, Dict[str, np.ndarray]]:
+    rng = np.random.default_rng(seed)
+    nested: Dict[str, Dict[str, np.ndarray]] = {}
+
+    def conv(name: str, k: int, c_in: int, c_out: int) -> None:
+        nested[name] = {
+            "weights": rng.normal(0, 0.05, (k, k, c_in, c_out)).astype(np.float32)
+        }
+
+    conv("conv1", 7, 3, 64)
+    nested["bn_conv1"] = _bn_entry(rng, 64)
+
+    c_in = 64
+    for prefix, width, n_identity in RESNET_STAGES:
+        # projection block: branch1 + branch2{a,b,c}
+        st = f"{prefix}a"
+        conv(f"res{st}_branch1", 1, c_in, 4 * width)
+        nested[f"bn{st}_branch1"] = _bn_entry(rng, 4 * width)
+        conv(f"res{st}_branch2a", 1, c_in, width)
+        nested[f"bn{st}_branch2a"] = _bn_entry(rng, width)
+        conv(f"res{st}_branch2b", 3, width, width)
+        nested[f"bn{st}_branch2b"] = _bn_entry(rng, width)
+        conv(f"res{st}_branch2c", 1, width, 4 * width)
+        nested[f"bn{st}_branch2c"] = _bn_entry(rng, 4 * width)
+        c_in = 4 * width
+        for i in range(n_identity):
+            st = f"{prefix}{chr(ord('b') + i)}"
+            conv(f"res{st}_branch2a", 1, c_in, width)
+            nested[f"bn{st}_branch2a"] = _bn_entry(rng, width)
+            conv(f"res{st}_branch2b", 3, width, width)
+            nested[f"bn{st}_branch2b"] = _bn_entry(rng, width)
+            conv(f"res{st}_branch2c", 1, width, 4 * width)
+            nested[f"bn{st}_branch2c"] = _bn_entry(rng, 4 * width)
+
+    np.save(path, np.array(nested, dtype=object), allow_pickle=True)
+    return nested
+
+
+def make_reference_train_checkpoint(
+    path: str, config, seed: int = 0, include_cnn: bool = True
+) -> Dict[str, np.ndarray]:
+    """Flat ``{var.name: value}`` dict as the reference's save() emits
+    (base_model.py:242-249) for the *train* graph with the 2-layer
+    initialize/attend/decode variants; returns the dict after np.save."""
+    rng = np.random.default_rng(seed)
+    E, H = config.dim_embedding, config.num_lstm_units
+    D, N, V = config.dim_ctx, config.num_ctx, config.vocabulary_size
+
+    def w(shape) -> np.ndarray:
+        return rng.normal(0, 0.08, shape).astype(np.float32)
+
+    flat: Dict[str, np.ndarray] = {}
+    if include_cnn and config.cnn == "vgg16":
+        c_in = 3
+        for name, c_out in VGG16_CONVS:
+            flat[f"{name}/kernel:0"] = w((3, 3, c_in, c_out))
+            flat[f"{name}/bias:0"] = w((c_out,))
+            c_in = c_out
+
+    flat["word_embedding/weights:0"] = w((V, E))
+
+    di = config.dim_initialize_layer
+    for fc, d_out in (("fc_a1", di), ("fc_b1", di)):
+        flat[f"initialize/{fc}/kernel:0"] = w((D, d_out))
+        flat[f"initialize/{fc}/bias:0"] = w((d_out,))
+    for fc in ("fc_a2", "fc_b2"):
+        flat[f"initialize/{fc}/kernel:0"] = w((di, H))
+        flat[f"initialize/{fc}/bias:0"] = w((H,))
+
+    da = config.dim_attend_layer
+    flat["attend/fc_1a/kernel:0"] = w((D, da))
+    flat["attend/fc_1a/bias:0"] = w((da,))
+    flat["attend/fc_1b/kernel:0"] = w((H, da))
+    flat["attend/fc_1b/bias:0"] = w((da,))
+    flat["attend/fc_2/kernel:0"] = w((da, 1))  # use_bias=False (model.py:436)
+
+    # TF1 LSTMCell under scope "lstm": one concatenated kernel
+    # [(input_depth + H), 4H], input = concat(context, word_embed)
+    # (model.py:277), gates ordered (i, j, f, o); +1.0 forget bias is
+    # applied at runtime, NOT stored.
+    flat["lstm/lstm_cell/kernel:0"] = w((D + E + H, 4 * H))
+    flat["lstm/lstm_cell/bias:0"] = w((4 * H,))
+
+    dd = config.dim_decode_layer
+    flat["decode/fc_1/kernel:0"] = w((H + D + E, dd))
+    flat["decode/fc_1/bias:0"] = w((dd,))
+    flat["decode/fc_2/kernel:0"] = w((dd, V))
+    flat["decode/fc_2/bias:0"] = w((V,))
+
+    flat["global_step:0"] = np.asarray(1234, np.int64)
+    # optimizer slots ride along in real checkpoints; must be skipped
+    flat["OptimizeLoss/word_embedding/weights/Adam:0"] = w((V, E))
+    flat["OptimizeLoss/beta1_power:0"] = np.asarray(0.9, np.float32)
+
+    np.save(path, np.array(flat, dtype=object), allow_pickle=True)
+    return flat

@@ -176,6 +176,130 @@ def test_pretrained_cnn_import(tmp_path, cnn):
         )
 
 
+def test_vgg16_no_fc_real_layout_imports_fully(tmp_path):
+    """Import the layout-exact vgg16_no_fc.npy twin (all 13 convs,
+    weights/biases names, HWIO shapes) — every tensor must land."""
+    from tests.ref_layouts import make_vgg16_no_fc
+
+    config = _tiny_config(cnn="vgg16", image_size=224)
+    variables = init_variables(jax.random.PRNGKey(0), config)
+    path = str(tmp_path / "vgg16_no_fc.npy")
+    nested = make_vgg16_no_fc(path)
+
+    new_vars, count = load_pretrained_cnn(variables, path)
+    assert count == 26  # 13 convs × (weights, biases)
+    for op in ("conv1_1", "conv3_2", "conv5_3"):
+        np.testing.assert_array_equal(
+            np.asarray(new_vars["params"]["cnn"][op]["conv"]["kernel"]),
+            nested[op]["weights"],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(new_vars["params"]["cnn"][op]["conv"]["bias"]),
+            nested[op]["biases"],
+        )
+
+
+def test_resnet50_no_fc_real_layout_imports_fully(tmp_path):
+    """resnet50_no_fc.npy twin: 53 bias-free convs + 53 BN entries with
+    caffe mean/variance/scale/offset names."""
+    from tests.ref_layouts import make_resnet50_no_fc
+
+    config = _tiny_config(cnn="resnet50", image_size=224)
+    variables = init_variables(jax.random.PRNGKey(0), config)
+    path = str(tmp_path / "resnet50_no_fc.npy")
+    nested = make_resnet50_no_fc(path)
+
+    new_vars, count = load_pretrained_cnn(variables, path)
+    assert count == 53 + 53 * 4  # convs + BN {scale,offset,mean,variance}
+    np.testing.assert_array_equal(
+        np.asarray(
+            new_vars["params"]["cnn"]["res4c"]["res4c_branch2b"]["conv"]["kernel"]
+        ),
+        nested["res4c_branch2b"]["weights"],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(new_vars["batch_stats"]["res3a"]["bn3a_branch1"]["mean"]),
+        nested["bn3a_branch1"]["mean"],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(new_vars["params"]["cnn"]["res5c"]["bn5c_branch2c"]["scale"]),
+        nested["bn5c_branch2c"]["scale"],
+    )
+
+
+def test_reference_train_checkpoint_decoder_logit_parity(tmp_path):
+    """Import a flat TF1-name checkpoint (lstm/lstm_cell concatenated
+    kernel, i-j-f-o gates) and check our decoder reproduces, bit-for-math,
+    a numpy oracle computing the reference semantics straight from the
+    checkpoint arrays — the 'silently wrong gate order' trap (SURVEY §7)."""
+    from sat_tpu.models.decoder import decoder_step, init_state
+    from sat_tpu.train.checkpoint import import_reference_checkpoint
+    from tests.ref_layouts import make_reference_train_checkpoint
+
+    config = _tiny_config()  # vgg16 @ 32px → N=4, D=512
+    path = str(tmp_path / "1234.npy")
+    flat = make_reference_train_checkpoint(path, config, include_cnn=True)
+
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    new_state, count = import_reference_checkpoint(state, path)
+    # decoder: emb 1 + initialize 8 + attend 5 + lstm 2 + decode 4 = 20
+    # cnn: 26.  Optimizer slots skipped.
+    assert count == 46
+    assert int(new_state.step) == 1234
+
+    B, N, D = 3, config.num_ctx, config.dim_ctx
+    rng = np.random.default_rng(3)
+    contexts = rng.normal(0, 1, (B, N, D)).astype(np.float32)
+    word = np.asarray([1, 4, 7], np.int32)
+
+    # ---- numpy oracle from the raw checkpoint arrays ----
+    def dense(name, x, tanh=False):
+        y = x @ flat[f"{name}/kernel:0"]
+        if f"{name}/bias:0" in flat:
+            y = y + flat[f"{name}/bias:0"]
+        return np.tanh(y) if tanh else y
+
+    def sigmoid(x):
+        return 1.0 / (1.0 + np.exp(-x))
+
+    ctx_mean = contexts.mean(axis=1)
+    memory0 = dense("initialize/fc_a2", dense("initialize/fc_a1", ctx_mean, True))
+    output0 = dense("initialize/fc_b2", dense("initialize/fc_b1", ctx_mean, True))
+
+    t1 = dense("attend/fc_1a", contexts, True)               # [B,N,da]
+    t2 = dense("attend/fc_1b", output0, True)                # [B,da]
+    att_logits = dense("attend/fc_2", t1 + t2[:, None, :])[..., 0]
+    e = np.exp(att_logits - att_logits.max(-1, keepdims=True))
+    alpha = e / e.sum(-1, keepdims=True)
+    context = (contexts * alpha[..., None]).sum(axis=1)
+
+    emb = flat["word_embedding/weights:0"][word]
+    z = (
+        np.concatenate([context, emb, output0], axis=-1)
+        @ flat["lstm/lstm_cell/kernel:0"]
+        + flat["lstm/lstm_cell/bias:0"]
+    )
+    i, j, f, o = np.split(z, 4, axis=-1)
+    c1 = sigmoid(f + 1.0) * memory0 + sigmoid(i) * np.tanh(j)
+    h1 = sigmoid(o) * np.tanh(c1)
+    expanded = np.concatenate([h1, context, emb], axis=-1)
+    want_logits = dense("decode/fc_2", dense("decode/fc_1", expanded, True))
+
+    # ---- our decoder with the imported params ----
+    params = jax.tree_util.tree_map(np.asarray, new_state.params)["decoder"]
+    state0 = init_state(params, config, jnp.asarray(contexts), train=False)
+    np.testing.assert_allclose(np.asarray(state0.memory), memory0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(state0.output), output0, atol=1e-4)
+    state1, got_logits, got_alpha = decoder_step(
+        params, config, jnp.asarray(contexts), state0, jnp.asarray(word),
+        train=False,
+    )
+    np.testing.assert_allclose(np.asarray(got_alpha), alpha, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(state1.memory), c1, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(state1.output), h1, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(got_logits), want_logits, atol=1e-3)
+
+
 def test_torn_config_json_falls_back_to_scan(tmp_path, rng):
     config = _tiny_config(save_dir=str(tmp_path))
     state = create_train_state(jax.random.PRNGKey(0), config)

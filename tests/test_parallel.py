@@ -55,6 +55,28 @@ def context_batch(config, batch=8, seed=0):
     }
 
 
+def test_initialize_distributed_single_host_is_noop(monkeypatch):
+    """A lone TPU_WORKER_HOSTNAMES entry or a 1-task SLURM allocation is a
+    single-process launch: bootstrapping a coordinator there crashes with
+    'coordinator_address should be defined' (regression: the axon single
+    -chip environment exports TPU_WORKER_HOSTNAMES=localhost)."""
+    from sat_tpu.parallel import initialize_distributed
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert initialize_distributed() is False
+
+    monkeypatch.setenv("SLURM_STEP_NODELIST", "node001")
+    monkeypatch.setenv("SLURM_NTASKS", "1")
+    assert initialize_distributed() is False
+
+    # but a real pod signal still wires up (>1 hostnames)
+    from sat_tpu.parallel import mesh as mesh_mod
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1")
+    assert mesh_mod._multihost_env_signal() is True
+
+
 def test_make_mesh_shapes():
     config = tiny_config(mesh_shape=(4, 2))
     mesh = make_mesh(config)
