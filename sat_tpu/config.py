@@ -111,6 +111,7 @@ class Config:
     num_data_workers: int = 8          # image-decode thread pool
     log_every: int = 10                # metric-writer cadence (steps)
     var_summary_period: int = 0        # per-variable stats cadence (0=off)
+    max_steps: int = 0                 # hard step cap across epochs (0=off)
     profile_dir: str = ""              # jax.profiler trace dir ("" = off)
     profile_start_step: int = 5        # first step inside the trace
     profile_num_steps: int = 3         # steps captured per trace

@@ -43,20 +43,48 @@ class DataSet:
         self.batch_size = batch_size
         self.is_train = is_train
         self.shuffle = shuffle
-        self._rng = np.random.default_rng(seed)
+        self._seed = 0 if seed is None else int(seed)
         self.setup()
 
     def setup(self) -> None:
         self.count = len(self.image_ids)
         self.num_batches = int(np.ceil(self.count / self.batch_size))
         self.fake_count = self.num_batches * self.batch_size - self.count
-        self.idxs = list(range(self.count))
-        self.reset()
+        self.epoch = -1
+        self._pending_seek = False
+        # position at epoch 0 with the seek pending, so both direct
+        # next_batch() use and the first __iter__ start on epoch 0
+        self.seek(0, 0)
+
+    def _set_epoch(self, epoch: int) -> None:
+        """Epoch order is a pure function of (seed, epoch) — no shuffle
+        history to replay — so a resumed run reproduces the exact batch
+        sequence of an uninterrupted one (the reference's stateful
+        shuffle-on-reset, dataset.py:37-41, cannot resume mid-stream)."""
+        self.epoch = epoch
+        rng = np.random.default_rng((self._seed, epoch))
+        self.idxs = (
+            list(rng.permutation(self.count))
+            if self.shuffle
+            else list(range(self.count))
+        )
+        # padding of the final partial batch draws from the same keyed rng
+        self._pad_idxs = list(rng.choice(self.count, self.fake_count)) \
+            if self.fake_count else []
 
     def reset(self) -> None:
+        """Advance to the next epoch's order (reference shuffle-on-reset,
+        dataset.py:37-41).  Cancels any pending seek."""
+        self._pending_seek = False
         self.current_idx = 0
-        if self.shuffle:
-            self._rng.shuffle(self.idxs)
+        self._set_epoch(self.epoch + 1)
+
+    def seek(self, epoch: int, batch_offset: int = 0) -> None:
+        """Position at (epoch, batch) — mid-epoch checkpoint resume.  The
+        next iteration start consumes this position instead of resetting."""
+        self._set_epoch(epoch)
+        self.current_idx = batch_offset * self.batch_size
+        self._pending_seek = True
 
     def has_next_batch(self) -> bool:
         return self.current_idx < self.count
@@ -72,9 +100,7 @@ class DataSet:
         if self.has_full_next_batch():
             current_idxs = self.idxs[self.current_idx : self.current_idx + self.batch_size]
         else:
-            current_idxs = self.idxs[self.current_idx : self.count] + list(
-                self._rng.choice(self.count, self.fake_count)
-            )
+            current_idxs = self.idxs[self.current_idx : self.count] + self._pad_idxs
         self.current_idx += self.batch_size
         image_files = self.image_files[current_idxs]
         if self.is_train:
@@ -82,7 +108,10 @@ class DataSet:
         return image_files
 
     def __iter__(self):
-        self.reset()
+        if self._pending_seek:
+            self._pending_seek = False  # consume the seek()ed position
+        else:
+            self.reset()
         while self.has_next_batch():
             yield self.next_batch()
 

@@ -33,6 +33,8 @@ from .evalcap.eval import CocoEvalCap
 from .models.captioner import encode, init_variables
 from .ops.beam_search import beam_search_jit
 from .train.checkpoint import (
+    apply_cnn_import,
+    import_reference_checkpoint,
     latest_checkpoint,
     restore_checkpoint,
     save_checkpoint,
@@ -64,8 +66,6 @@ def setup_state(
             # a checkpoint written by the *reference* itself (flat TF1
             # var.name dict, base_model.py:242-249) — imported via the
             # name-translation path so reference-trained models run here
-            from .train.checkpoint import import_reference_checkpoint
-
             state, count = import_reference_checkpoint(state, model_file)
         else:
             state, count = restore_checkpoint(
@@ -77,8 +77,6 @@ def setup_state(
             )
         print(f"{count} tensors loaded from checkpoint (step {int(state.step)}).")
     if load_cnn and cnn_model_file:
-        from .train.checkpoint import apply_cnn_import
-
         state, count = apply_cnn_import(state, cnn_model_file)
         print(f"{count} pretrained CNN tensors loaded.")
     return state
@@ -150,9 +148,20 @@ def train(
     # with the device and defeating async dispatch + prefetch.  Sync once
     # here (resume-aware), then count locally; device_get only when logging.
     step = int(state.step)
+    # Mid-epoch resume: batch order is a pure function of (seed, epoch)
+    # (DataSet._set_epoch), so the cursor IS the global step — fast-forward
+    # to exactly where the checkpointed run stopped and the resumed run
+    # replays the identical batch + dropout-key sequence.
+    start_epoch, skip_batches = divmod(step, dataset.num_batches)
+    if start_epoch < config.num_epochs:
+        dataset.seek(start_epoch, skip_batches)
+    stopped = False
     with SummaryWriter(config.summary_dir) as writer:
-        for epoch in range(config.num_epochs):
+        for epoch in range(start_epoch, config.num_epochs):
             for batch in loader:
+                if config.max_steps and step >= config.max_steps:
+                    stopped = True
+                    break
                 # >= not ==: a run resumed past profile_start_step still
                 # profiles (once) instead of silently never tracing
                 if (
@@ -189,6 +198,8 @@ def train(
                     writer.variable_stats(step, state.params)
                 if config.save_period and step % config.save_period == 0:
                     save_checkpoint(state, config)
+            if stopped:
+                break
             print(f"epoch {epoch + 1}/{config.num_epochs} done (step {int(state.step)})")
         if profiling:
             jax.block_until_ready(state)

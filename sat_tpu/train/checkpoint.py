@@ -309,7 +309,9 @@ def load_pretrained_cnn(
 _DECODER_SCOPES = ("word_embedding", "initialize", "attend", "decode")
 
 
-def import_reference_checkpoint(state: Any, path: str) -> Tuple[Any, int]:
+def import_reference_checkpoint(
+    state: Any, path: str, restore_step: bool = False
+) -> Tuple[Any, int]:
     """Ingest a checkpoint written by the reference's own save():
     a flat ``{var.name: value}`` npy (base_model.py:242-249).
 
@@ -327,8 +329,11 @@ def import_reference_checkpoint(state: Any, path: str) -> Tuple[Any, int]:
       BN gamma/beta/moving_mean/moving_variance) place through the same
       alias machinery as the nested pretrained import;
     * optimizer slots (``OptimizeLoss/...``) are dropped — the reference's
-      Adam state has no meaning for our optax chain; ``global_step:0``
-      restores the step counter.
+      Adam state has no meaning for our optax chain.  ``global_step:0`` is
+      only adopted with ``restore_step=True``: a foreign step count would
+      otherwise drive the train loop's resume fast-forward (skipping
+      epochs, or no-opping entirely when it exceeds the epoch budget) —
+      fine-tuning an imported model starts a fresh optimization at step 0.
 
     Returns (new_state, tensors_loaded).
     """
@@ -353,7 +358,7 @@ def import_reference_checkpoint(state: Any, path: str) -> Tuple[Any, int]:
 
     params, n_dec = _assign_leaves(state.params, "params/", decoder_flat)
     new_state, n_cnn = apply_cnn_import(state._replace(params=params), cnn_nested)
-    if step is not None:
+    if restore_step and step is not None:
         new_state = new_state._replace(step=step)
     return new_state, n_dec + n_cnn
 

@@ -245,7 +245,11 @@ def test_reference_train_checkpoint_decoder_logit_parity(tmp_path):
     # decoder: emb 1 + initialize 8 + attend 5 + lstm 2 + decode 4 = 20
     # cnn: 26.  Optimizer slots skipped.
     assert count == 46
-    assert int(new_state.step) == 1234
+    # the foreign step counter is NOT adopted by default (it would drive
+    # the resume fast-forward); opt-in via restore_step
+    assert int(new_state.step) == 0
+    stepped, _ = import_reference_checkpoint(state, path, restore_step=True)
+    assert int(stepped.step) == 1234
 
     B, N, D = 3, config.num_ctx, config.dim_ctx
     rng = np.random.default_rng(3)

@@ -288,6 +288,70 @@ def test_eval_sweep_scores_every_checkpoint(trained):
         assert os.path.exists(os.path.join(config.save_dir, f"{step}.txt"))
 
 
+def test_preempt_and_resume_equals_uninterrupted(coco_fixture, tmp_path):
+    """Kill-and-resume: a run preempted mid-epoch (after a checkpoint) and
+    resumed must produce bitwise the params of an uninterrupted run.  Batch
+    order is a pure function of (seed, epoch) and dropout keys of the global
+    step, so the resumed run replays the identical sequence — the
+    checkpoint cursor story VERDICT r1 item 9 asks to prove."""
+    base = coco_fixture["config"].replace(**SMALL_MODEL)
+
+    # uninterrupted oracle: 2 epochs (24 anns / batch 4 = 6 steps/epoch)
+    cfg_full = base.replace(
+        num_epochs=2,
+        save_dir=str(tmp_path / "full"), summary_dir=str(tmp_path / "fs"),
+    )
+    want = runtime.train(cfg_full)
+    assert int(want.step) == 12
+
+    # preempted run: hard-stopped mid-epoch-2 at step 8 (save on exit)
+    cfg_a = base.replace(
+        num_epochs=2, max_steps=8,
+        save_dir=str(tmp_path / "resume"), summary_dir=str(tmp_path / "rs"),
+    )
+    state_a = runtime.train(cfg_a)
+    assert int(state_a.step) == 8
+    assert latest_checkpoint(cfg_a.save_dir).endswith("8.npz")
+
+    # resume in a FRESH process-equivalent: new state skeleton, restore,
+    # continue to completion
+    cfg_b = cfg_a.replace(max_steps=0)
+    state_b = runtime.setup_state(cfg_b, load=True)
+    assert int(state_b.step) == 8
+    state_b = runtime.train(cfg_b, state=state_b)
+    assert int(state_b.step) == 12
+
+    from sat_tpu.train.checkpoint import state_to_flat
+
+    got, ref = state_to_flat(state_b), state_to_flat(want)
+    assert set(got) == set(ref)
+    for k in ref:
+        np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+
+
+def test_dataset_seek_replays_exact_sequence(coco_fixture):
+    """DataSet.seek(e, b) must reproduce the tail of epoch e exactly as an
+    uninterrupted pass over that epoch produced it."""
+    from sat_tpu.data.dataset import prepare_train_data
+
+    config = coco_fixture["config"]
+    ds = prepare_train_data(config)
+    orders = []
+    for _ in range(3):  # epochs 0..2 as a fresh run sees them
+        epoch_files = []
+        for batch in ds:
+            epoch_files.append(tuple(batch[0]))
+        orders.append(epoch_files)
+    assert orders[0] != orders[1]  # shuffling actually happens
+
+    ds2 = prepare_train_data(config)
+    ds2.seek(1, 2)  # resume mid-epoch-1 at batch 2
+    replay = [tuple(b[0]) for b in ds2]
+    assert replay == orders[1][2:]
+    # and the following epoch continues the same sequence
+    assert [tuple(b[0]) for b in ds2] == orders[2]
+
+
 def test_train_with_profiler_and_var_stats(coco_fixture, tmp_path):
     """Profiler trace + per-variable stats hooks (SURVEY.md §5 tracing)."""
     config = coco_fixture["config"].replace(
