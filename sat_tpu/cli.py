@@ -84,6 +84,9 @@ def build_config(argv: Optional[List[str]] = None):
         overrides[key] = _parse_override(config, key, raw)
     if overrides:
         config = config.replace(**overrides)
+    # env-driven path re-rooting (SAT_DATA_ROOT / SAT_LOG_ROOT); explicit
+    # --set overrides win because re-rooting only touches default values
+    config = config.apply_env_paths()
 
     cli = {
         "load": args.load,

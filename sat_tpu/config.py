@@ -151,6 +151,46 @@ class Config:
     def is_train(self) -> bool:
         return self.phase == "train"
 
+    # Path fields re-rooted by the SAT_DATA_ROOT / SAT_LOG_ROOT env vars
+    # (apply_env_paths below).
+    DATA_PATH_FIELDS = (
+        "vocabulary_file", "train_image_dir", "train_caption_file",
+        "temp_annotation_file", "temp_data_file", "eval_image_dir",
+        "eval_caption_file", "test_image_dir",
+    )
+    LOG_PATH_FIELDS = (
+        "save_dir", "summary_dir", "profile_dir", "eval_result_dir",
+        "eval_result_file", "test_result_dir", "test_result_file",
+    )
+
+    def apply_env_paths(self) -> "Config":
+        """Environment-driven data/log path indirection — the capability of
+        the reference's clusterone get_data_path/get_logs_path wrappers
+        (/root/reference/clusterone_config.py:64-85): the same config runs
+        locally or on a cluster whose storage is mounted elsewhere.
+
+        ``SAT_DATA_ROOT`` re-roots input paths (datasets, caption JSONs,
+        vocab, preprocessing caches); ``SAT_LOG_ROOT`` re-roots output
+        paths (checkpoints, summaries, profiles, results).  Only fields
+        still holding their *default* value are re-rooted — an explicit
+        ``--set`` or programmatic override always wins.  Relative defaults
+        like ``./data/train/images/`` become ``<root>/data/train/images/``.
+        """
+        updates: Dict[str, Any] = {}
+        defaults = Config()
+        for env, fields in (
+            ("SAT_DATA_ROOT", self.DATA_PATH_FIELDS),
+            ("SAT_LOG_ROOT", self.LOG_PATH_FIELDS),
+        ):
+            root = os.environ.get(env)
+            if not root:
+                continue
+            for name in fields:
+                value = getattr(self, name)
+                if value and value == getattr(defaults, name):
+                    updates[name] = os.path.join(root, value.lstrip("./"))
+        return self.replace(**updates) if updates else self
+
     @property
     def num_ctx(self) -> int:
         """Spatial context-grid size (reference model.py:58,107): 196 for

@@ -146,10 +146,18 @@ def prepare_train_data(config: Config) -> DataSet:
         ]
         import pandas as pd
 
+        from ..utils.fileio import atomic_write
+
         os.makedirs(os.path.dirname(config.temp_annotation_file) or ".", exist_ok=True)
-        pd.DataFrame(
-            {"image_id": image_ids, "image_file": image_files, "caption": captions}
-        ).to_csv(config.temp_annotation_file)
+        # atomic: concurrent processes (multi-host prep over a shared fs)
+        # must never observe a half-written cache
+        atomic_write(
+            config.temp_annotation_file,
+            "w",
+            lambda f: pd.DataFrame(
+                {"image_id": image_ids, "image_file": image_files, "caption": captions}
+            ).to_csv(f),
+        )
     else:
         import pandas as pd
 
@@ -167,8 +175,16 @@ def prepare_train_data(config: Config) -> DataSet:
             n_words = min(len(idxs), config.max_caption_length)
             word_idxs[i, :n_words] = idxs[:n_words]
             masks[i, :n_words] = 1.0
+        from ..utils.fileio import atomic_write
+
         os.makedirs(os.path.dirname(config.temp_data_file) or ".", exist_ok=True)
-        np.save(config.temp_data_file, {"word_idxs": word_idxs, "masks": masks})
+        atomic_write(
+            config.temp_data_file,
+            "wb",
+            lambda f: np.save(
+                f, {"word_idxs": word_idxs, "masks": masks}, allow_pickle=True
+            ),
+        )
     else:
         data = np.load(config.temp_data_file, allow_pickle=True).item()
         word_idxs, masks = data["word_idxs"], data["masks"]

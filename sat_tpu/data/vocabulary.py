@@ -85,14 +85,21 @@ class Vocabulary:
     def save(self, save_file: str) -> None:
         import pandas as pd
 
-        os.makedirs(os.path.dirname(save_file) or ".", exist_ok=True)
-        pd.DataFrame(
-            {
-                "word": list(self.words),
-                "index": list(range(self.size)),
-                "frequency": list(np.asarray(self.word_frequencies)),
-            }
-        ).to_csv(save_file)
+        from ..utils.fileio import atomic_write
+
+        # atomic: concurrent multi-host data prep must never read a
+        # half-written vocabulary
+        atomic_write(
+            save_file,
+            "w",
+            lambda f: pd.DataFrame(
+                {
+                    "word": list(self.words),
+                    "index": list(range(self.size)),
+                    "frequency": list(np.asarray(self.word_frequencies)),
+                }
+            ).to_csv(f),
+        )
 
     def load(self, save_file: str) -> None:
         import pandas as pd

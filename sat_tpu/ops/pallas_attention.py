@@ -1,37 +1,35 @@
-"""Fused soft-attention step as a Pallas TPU kernel.
+"""Fused soft-attention step as a batched Pallas TPU kernel.
 
-At decode time the attention step is, per image (reference attend,
+At decode time the attention step is (reference attend,
 /root/reference/model.py:395-436, 2-layer variant):
 
-    temp   = t1 + t2[None, :]        # [N, da]  (t1 = tanh(fc_1a(ctx)), hoisted)
-    logits = temp @ w2               # [N]
-    alpha  = softmax(logits)         # [N]
-    ctx    = alpha @ contexts        # [D]
+    temp   = t1 + t2[:, None, :]     # [B, N, da]  (t1 hoisted, loop-invariant)
+    logits = temp @ w2               # [B, N]
+    alpha  = softmax(logits)         # [B, N]
+    ctx    = alpha @ contexts        # [B, D]
 
-Unfused, XLA materializes temp/logits/alpha between HBM round-trips per
-scan step.  This kernel performs the whole chain in one VMEM residency per
-batch row: the [N,da]×[da,1] scoring matmul rides the MXU, softmax and the
-weighted sum run on the VPU, and only the [D] context vector and [N] alpha
-leave chip memory.
+The op is bandwidth-bound: the matvec against w2 gives it an arithmetic
+intensity of ~1 flop/byte, so the win is HBM traffic, not MXU time.  XLA
+materializes intermediates between fusions; this kernel streams one batch
+tile's t1/contexts through VMEM exactly once — add, scoring reduction,
+softmax, and the weighted context sum all happen in a single residency and
+only alpha [B,N] and the context vector [B,D] go back to HBM.
 
-Mosaic layout notes: the context-grid axis N (196 for VGG16) is padded to
-a sublane-aligned multiple of 8 and kept as the *sublane* dimension
-throughout — logits/alpha live as [N_pad, 1] columns so every reduction is
-over an aligned axis, and a -inf logit bias masks the padding rows out of
-the softmax.
+Layout: the grid tiles the *batch* axis (``block_b`` rows per program, 8 by
+default) so one program covers a [block_b·N, da] volume rather than the
+per-image slivers of the round-1 kernel.  N stays the sublane axis, da/D
+the lane axis; reductions are lane-axis (scoring, context sum) or
+sublane-axis (softmax) — both Mosaic-native.  The context-grid axis is
+padded to a multiple of 8 with a -inf logit bias masking the pad rows out
+of the softmax; the batch axis is padded to a multiple of ``block_b``.
 
 Used at inference (beam search / greedy); training keeps the XLA path
-(per-step dropout on contexts makes the hoisted t1 invalid there, and XLA
-fuses the rest fine in the backward pass).  ``interpret=True`` runs the
-same kernel on CPU for tests.
+(per-step dropout on contexts invalidates the t1 hoist there).
+``interpret=True`` runs the same kernel on CPU for tests.
 
-Measured on v5e-1 at the reference shapes (N=196, da=D=512, batch 48):
-XLA's fully-fused scan decodes a 16-image batch in ~0.24 ms once the t1
-hoist is in place, while this kernel's per-image grid serializes 48 tiny
-programs per step and lands ~300x slower — so ``use_pallas_attention``
-defaults to False and the kernel is kept as the building block for larger
-context grids (bigger images / finer feature maps), where one image's
-attention alone fills the MXU and the fusion pays off.
+VMEM budget per program at flagship shapes (N=196→200, da=D=512, block_b=8,
+fp32): t1 3.3 MB + contexts 3.3 MB + outputs ≈ 6.8 MB — comfortably inside
+the ~16 MB/core budget (see /opt/skills/guides/pallas_guide.md).
 """
 
 from __future__ import annotations
@@ -49,39 +47,44 @@ _NEG_INF = -1e30
 # mode even off-TPU (production non-TPU uses the XLA fallback instead).
 FORCE_INTERPRET = False
 
+# Batch rows per program.  8 keeps the VMEM residency ~7 MB at flagship
+# shapes while giving Mosaic full-width vector work on every axis.
+DEFAULT_BLOCK_B = 8
+
 
 def _make_kernel(compute_dtype):
     dt = jnp.dtype(compute_dtype)
 
     def _kernel(t1_ref, t2_ref, w2_ref, bias_ref, ctx_ref,
                 out_ctx_ref, out_alpha_ref):
-        # blocks: t1 [1,Np,da], t2 [1,1,da], w2 [da,1], bias [Np,1],
-        #         ctx [1,Np,D], out_ctx [1,1,D], out_alpha [1,Np,1]
-        temp = t1_ref[0] + t2_ref[0]                               # [Np, da]
-        # scoring matvec in the model's compute dtype (mirrors _dense:
-        # bf16 MXU inputs, fp32 accumulate — Mosaic requires a 32-bit
-        # acc — then round the result through dt like XLA's bf16 matmul)
-        logits = (
-            jnp.dot(
-                temp.astype(dt), w2_ref[:, :].astype(dt),
-                preferred_element_type=jnp.float32,
-            )
-            .astype(dt)
-            .astype(jnp.float32)
-        )
-        logits = logits + bias_ref[:, :]                           # [Np, 1]
-        m = jnp.max(logits, axis=0, keepdims=True)                 # [1, 1]
-        e = jnp.exp(logits - m)                                    # [Np, 1]
-        s = jnp.sum(e, axis=0, keepdims=True)                      # [1, 1]
-        alpha = e / s                                              # [Np, 1]
-        out_alpha_ref[0, :, :] = alpha
-        # weighted sum over the aligned sublane axis (VPU, fp32)
-        out_ctx_ref[0, 0, :] = jnp.sum(alpha * ctx_ref[0], axis=0)  # [D]
+        # blocks: t1 [Bt,Np,da], t2 [Bt,1,da], w2 [1,da], bias [1,Np],
+        #         ctx [Bt,Np,D], out_ctx [Bt,D], out_alpha [Bt,Np]
+        temp = t1_ref[...] + t2_ref[...]                           # [Bt,Np,da]
+        # scoring: temp·w2 contracted over the lane axis.  A [.,da]@[da,1]
+        # matvec cannot fill the MXU; an elementwise-mul + lane reduction
+        # is the same flops on the VPU without the degenerate-matmul
+        # layout.  Mirror _dense's dtype story: bf16 multiply, fp32
+        # accumulate, round through dt like XLA's bf16 matmul output.
+        prod = temp.astype(dt).astype(jnp.float32) * w2_ref[0].astype(
+            dt
+        ).astype(jnp.float32)
+        logits = jnp.sum(prod, axis=-1).astype(dt).astype(jnp.float32)
+        logits = logits + bias_ref[...]                            # [Bt,Np]
+        m = jnp.max(logits, axis=1, keepdims=True)                 # [Bt,1]
+        e = jnp.exp(logits - m)
+        alpha = e / jnp.sum(e, axis=1, keepdims=True)              # [Bt,Np]
+        out_alpha_ref[...] = alpha
+        # weighted context sum: lane-preserving sublane reduction
+        out_ctx_ref[...] = jnp.sum(
+            alpha[:, :, None] * ctx_ref[...], axis=1
+        )                                                          # [Bt,D]
 
     return _kernel
 
 
-@partial(jax.jit, static_argnames=("compute_dtype", "interpret"))
+@partial(
+    jax.jit, static_argnames=("compute_dtype", "interpret", "block_b")
+)
 def fused_attend(
     t1: jnp.ndarray,
     t2: jnp.ndarray,
@@ -89,6 +92,7 @@ def fused_attend(
     contexts: jnp.ndarray,
     compute_dtype: str = "float32",
     interpret: bool = False,
+    block_b: int = DEFAULT_BLOCK_B,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(context [B,D], alpha [B,N]) from hoisted attention inputs.
 
@@ -96,45 +100,50 @@ def fused_attend(
     t2: [B, da]    fp32 — tanh(fc_1b(output)) for the current step.
     w2: [da, 1]    fp32 — second-layer projection.
     contexts: [B, N, D] fp32.
-    compute_dtype: the scoring matmul dtype (the model's MXU dtype).
+    compute_dtype: the scoring multiply dtype (the model's MXU dtype).
     """
     B, N, da = t1.shape
     D = contexts.shape[-1]
     n_pad = (-N) % 8
     Np = N + n_pad
+    bt = max(1, min(block_b, B))
+    b_pad = (-B) % bt
+    Bp = B + b_pad
 
-    t1 = jnp.pad(t1.astype(jnp.float32), ((0, 0), (0, n_pad), (0, 0)))
+    t1 = jnp.pad(t1.astype(jnp.float32), ((0, b_pad), (0, n_pad), (0, 0)))
     contexts_p = jnp.pad(
-        contexts.astype(jnp.float32), ((0, 0), (0, n_pad), (0, 0))
+        contexts.astype(jnp.float32), ((0, b_pad), (0, n_pad), (0, 0))
     )
-    t2 = t2.astype(jnp.float32).reshape(B, 1, da)
-    w2 = w2.astype(jnp.float32)
-    # padding rows get -inf logits so they vanish from the softmax
+    t2 = jnp.pad(t2.astype(jnp.float32), ((0, b_pad), (0, 0))).reshape(
+        Bp, 1, da
+    )
+    w2_row = w2.astype(jnp.float32).reshape(1, da)
+    # padding grid rows get -inf logits so they vanish from the softmax
     bias = jnp.where(
-        (jnp.arange(Np) < N)[:, None], 0.0, _NEG_INF
-    ).astype(jnp.float32)                                          # [Np, 1]
+        (jnp.arange(Np) < N)[None, :], 0.0, _NEG_INF
+    ).astype(jnp.float32)                                          # [1, Np]
 
     out_ctx, out_alpha = pl.pallas_call(
         _make_kernel(compute_dtype),
-        grid=(B,),
+        grid=(Bp // bt,),
         in_specs=[
-            pl.BlockSpec((1, Np, da), lambda b: (b, 0, 0)),
-            pl.BlockSpec((1, 1, da), lambda b: (b, 0, 0)),
-            pl.BlockSpec((da, 1), lambda b: (0, 0)),
-            pl.BlockSpec((Np, 1), lambda b: (0, 0)),
-            pl.BlockSpec((1, Np, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec((bt, Np, da), lambda b: (b, 0, 0)),
+            pl.BlockSpec((bt, 1, da), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, da), lambda b: (0, 0)),
+            pl.BlockSpec((1, Np), lambda b: (0, 0)),
+            pl.BlockSpec((bt, Np, D), lambda b: (b, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, D), lambda b: (b, 0, 0)),
-            pl.BlockSpec((1, Np, 1), lambda b: (b, 0, 0)),
+            pl.BlockSpec((bt, D), lambda b: (b, 0)),
+            pl.BlockSpec((bt, Np), lambda b: (b, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, 1, D), jnp.float32),
-            jax.ShapeDtypeStruct((B, Np, 1), jnp.float32),
+            jax.ShapeDtypeStruct((Bp, D), jnp.float32),
+            jax.ShapeDtypeStruct((Bp, Np), jnp.float32),
         ],
         interpret=interpret,
-    )(t1, t2, w2, bias, contexts_p)
-    return out_ctx[:, 0], out_alpha[:, :N, 0]
+    )(t1, t2, w2_row, bias, contexts_p)
+    return out_ctx[:B], out_alpha[:B, :N]
 
 
 def fused_attend_reference(

@@ -17,6 +17,29 @@ import jax
 from ..data.dataset import DataSet
 
 
+def pad_dataset_for_processes(dataset: DataSet, process_count: int) -> DataSet:
+    """Pad an *unshuffled* eval/test DataSet to a count divisible by
+    ``process_count`` by repeating trailing rows, so every host's shard has
+    the same number of batches (a short shard would desynchronize the SPMD
+    decode collectives).  The padding rows are duplicates of real images;
+    result assembly cuts at the original count, mirroring the fake_count
+    convention (reference dataset.py:51-54)."""
+    pad = (-dataset.count) % process_count
+    if pad == 0:
+        return dataset
+    # modulo tiling: pad may exceed count (tiny dataset, many hosts)
+    idx = list(range(dataset.count)) + [i % dataset.count for i in range(pad)]
+    return DataSet(
+        dataset.image_ids[idx],
+        dataset.image_files[idx],
+        dataset.batch_size,
+        None if dataset.word_idxs is None else dataset.word_idxs[idx],
+        None if dataset.masks is None else dataset.masks[idx],
+        is_train=dataset.is_train,
+        shuffle=False,
+    )
+
+
 def process_local_dataset(
     dataset: DataSet,
     process_index: Optional[int] = None,

@@ -236,22 +236,16 @@ def decode_dataset(
     # Mesh-parallel decoding: encoder + beam search in one jitted program
     # with the image batch sharded over 'data' — eval/test scale over the
     # mesh exactly like training does (reference capability:
-    # base_model.py:70-117, which is strictly single-device).
+    # base_model.py:70-117, which is strictly single-device).  Multi-host:
+    # each process feeds its shard of the dataset and the beam results are
+    # all-gathered so every host assembles the full result list.
     if int(np.prod(config.mesh_shape)) > 1:
         from .parallel import make_mesh
         from .parallel.collectives import make_global_batch
+        from .parallel.data import pad_dataset_for_processes, process_local_dataset
         from .parallel.sharding import replicated
         from .parallel.train import make_parallel_beam_search
 
-        if jax.process_count() > 1:
-            # Multi-host decoding needs per-host dataset slicing plus a
-            # cross-host gather of the (non-fully-addressable) beam
-            # results; until that lands, eval/test on a multi-host mesh
-            # must run single-host (training IS multi-host capable).
-            raise NotImplementedError(
-                "mesh decoding supports single-host meshes only; run "
-                "--phase=eval/test with one process"
-            )
         mesh = make_mesh(config)
         dp = mesh.shape.get("data", 1)
         if config.batch_size % dp != 0:
@@ -269,6 +263,36 @@ def decode_dataset(
         def run_batch(batch):
             images = make_global_batch(mesh, {"images": batch["images"]})
             return caption_fn(variables, images["images"])
+
+        pc = jax.process_count()
+        if pc > 1:
+            padded = pad_dataset_for_processes(dataset, pc)
+            local_ds = process_local_dataset(padded)
+            loader = PrefetchLoader(
+                local_ds,
+                ImageLoader(size=config.image_size),
+                num_workers=config.num_data_workers,
+                prefetch_depth=config.prefetch_depth,
+            )
+            from .utils.dist import gather_tree_replicated
+
+            gathered = []
+            for batch in loader:
+                out = run_batch(batch)
+                # assembly only consumes beam 0: slice on device, then one
+                # batched cross-host gather for the whole tuple
+                best = jax.tree_util.tree_map(
+                    lambda x: x[:, 0],
+                    (out.words, out.lengths, out.log_scores),
+                )
+                gathered.append(
+                    tuple(
+                        np.asarray(x) for x in gather_tree_replicated(best)
+                    )
+                )
+            return _assemble_mesh_results(
+                dataset, vocabulary, gathered, pc, local_ds.count
+            )
 
     else:
 
@@ -320,6 +344,61 @@ def decode_dataset(
                     "prob": float(np.exp(scores[i])),
                 }
             )
+    return results
+
+
+def _assemble_mesh_results(
+    dataset: DataSet,
+    vocabulary: Vocabulary,
+    gathered: List[Tuple[np.ndarray, np.ndarray, np.ndarray]],
+    process_count: int,
+    local_count: int,
+) -> List[Dict[str, Any]]:
+    """Merge all-gathered multi-host beam-0 results back into dataset order.
+
+    ``gathered[b]`` = (words [B,T], lengths [B], scores [B]) for global
+    batch ``b`` — the best beam per image, already gathered to every host.
+    Row layout: the global batch concatenates per-process blocks in
+    process order (make_global_batch), each process holding rows
+    ``pi::process_count`` of the process-padded dataset
+    (process_local_dataset's interleaved slice).  So gathered batch ``b``
+    row ``h*local_b + j`` is local row ``i = b*local_b + j`` of host ``h``
+    = padded-global row ``h + i*process_count``; rows past the local count
+    (per-host fake_count batch padding) and past ``dataset.count``
+    (process padding) are dropped, then the usual per-image dedup applies
+    (reference base_model.py:83-88).
+    """
+    by_row: Dict[int, Tuple] = {}
+    for b, (words, lengths, scores) in enumerate(gathered):
+        local_b = words.shape[0] // process_count
+        for h in range(process_count):
+            for j in range(local_b):
+                i = b * local_b + j
+                if i >= local_count:
+                    continue                     # per-host fake_count pad
+                g = h + i * process_count
+                if g < dataset.count:            # process-divisibility pad
+                    row = h * local_b + j
+                    by_row[g] = (words[row], lengths[row], scores[row])
+
+    results: List[Dict[str, Any]] = []
+    seen = set()
+    for g in sorted(by_row):                     # dataset order + dedup
+        image_id = int(dataset.image_ids[g])
+        if image_id in seen:
+            continue
+        seen.add(image_id)
+        word_row, length, score = by_row[g]
+        results.append(
+            {
+                "image_id": image_id,
+                "image_file": str(dataset.image_files[g]),
+                "caption": vocabulary.get_sentence(
+                    word_row[: max(1, int(length))]
+                ),
+                "prob": float(np.exp(score)),
+            }
+        )
     return results
 
 

@@ -1,0 +1,108 @@
+"""Measure the fused Pallas attention kernel against XLA on the real chip.
+
+Decides the fate of ``use_pallas_attention`` (VERDICT r1 item 6): flagship
+decode shapes, both implementations timed over identical inputs, plus the
+end-to-end beam-search step impact.  Run on TPU (no JAX_PLATFORMS override).
+
+Usage: python scripts/bench_pallas.py [--batch 48] [--iters 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timeit(fn, args, iters: int, warmup: int = 5) -> float:
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=48, help="B (images × beams)")
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--block-b", type=int, default=0, help="0 = sweep")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from sat_tpu.ops.pallas_attention import fused_attend, fused_attend_reference
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})", flush=True)
+
+    # flagship decode shapes: VGG16 grid N=196, da=D=512
+    B, N, da, D = args.batch, 196, 512, 512
+    rng = np.random.default_rng(0)
+    t1 = jnp.asarray(rng.normal(size=(B, N, da)).astype(np.float32))
+    t2 = jnp.asarray(rng.normal(size=(B, da)).astype(np.float32))
+    w2 = jnp.asarray(rng.normal(size=(da, 1)).astype(np.float32))
+    ctx = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+
+    xla = jax.jit(fused_attend_reference, static_argnames=("compute_dtype",))
+    t_xla = timeit(xla, (t1, t2, w2, ctx), args.iters)
+    traffic_mb = (t1.nbytes + ctx.nbytes) / 1e6
+    print(
+        f"XLA fused:    {t_xla*1e6:8.1f} us   "
+        f"(~{traffic_mb / t_xla / 1e3:.0f} GB/s effective)", flush=True,
+    )
+
+    blocks = [args.block_b] if args.block_b else [4, 8, 16]
+    best = (None, float("inf"))
+    for bb in blocks:
+        try:
+            t_pal = timeit(
+                lambda *a: fused_attend(*a, block_b=bb),
+                (t1, t2, w2, ctx), args.iters,
+            )
+        except Exception as e:  # mosaic lowering failure at this tiling
+            print(f"pallas bb={bb}: FAILED ({type(e).__name__}: {e})", flush=True)
+            continue
+        print(
+            f"pallas bb={bb:2d}: {t_pal*1e6:8.1f} us   "
+            f"(~{traffic_mb / t_pal / 1e3:.0f} GB/s effective)", flush=True,
+        )
+        if t_pal < best[1]:
+            best = (bb, t_pal)
+
+    if best[0] is None:
+        print("verdict: pallas kernel failed to run — keep XLA path")
+        return 1
+    speedup = t_xla / best[1]
+    print(f"best pallas: block_b={best[0]}  speedup vs XLA: {speedup:.2f}x")
+    print(
+        "verdict: ENABLE use_pallas_attention"
+        if speedup > 1.05
+        else "verdict: keep XLA path (no win)"
+    )
+    # correctness cross-check on device
+    want = fused_attend_reference(t1, t2, w2, ctx)
+    got = fused_attend(t1, t2, w2, ctx, block_b=best[0])
+    np.testing.assert_allclose(
+        np.asarray(got[1]), np.asarray(want[1]), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(got[0]), np.asarray(want[0]), rtol=2e-4, atol=2e-4
+    )
+    print("on-device correctness: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

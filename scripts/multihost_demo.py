@@ -1,0 +1,112 @@
+"""Two-process distributed train + eval demo/proof on CPU.
+
+Launches N real OS processes that bootstrap a jax.distributed cluster over
+a loopback coordinator (the TPU-native replacement for the reference's
+tf.train.Server/ClusterSpec plumbing, /root/reference/clusterone_config.py:
+106-124), build a (N,1) device mesh spanning the processes, train the
+captioner with per-host data sharding + XLA-inserted gradient all-reduce,
+checkpoint from the sharded state, and run multi-host mesh-parallel
+beam-search eval with cross-host result gather.
+
+Run: python scripts/multihost_demo.py [--procs 2]
+Exit 0 = multi-host train + eval completed and all hosts agreed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import json, os, sys
+repo, pid, nprocs, port, root = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]), sys.argv[5]
+)
+sys.path.insert(0, repo)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from sat_tpu.parallel import initialize_distributed
+initialize_distributed(
+    coordinator_address="127.0.0.1:%d" % port, num_processes=nprocs, process_id=pid
+)
+assert jax.process_count() == nprocs, jax.process_count()
+
+from sat_tpu.config import Config
+config = Config.load(os.path.join(root, "config.json")).replace(
+    summary_dir=os.path.join(root, "summary_p%d" % pid),
+)
+
+from sat_tpu import runtime
+state = runtime.train(config)
+print("[p%d] trained to step %d" % (pid, int(jax.device_get(state.step))), flush=True)
+
+scores = runtime.evaluate(config, state=state)
+with open(os.path.join(root, "scores_p%d.json" % pid), "w") as f:
+    json.dump(scores, f)
+print("[p%d] eval done" % pid, flush=True)
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--port", type=int, default=12765)
+    ap.add_argument("--root", default="/tmp/sat_tpu_multihost_demo")
+    args = ap.parse_args()
+
+    sys.path.insert(0, REPO)
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    os.makedirs(args.root, exist_ok=True)
+
+    from fixtures import make_coco_fixture
+
+    fx = make_coco_fixture(args.root)
+    config = fx["config"].replace(
+        image_size=32, dim_embedding=16, num_lstm_units=16,
+        dim_initialize_layer=16, dim_attend_layer=16, dim_decode_layer=32,
+        compute_dtype="float32", num_epochs=1, save_period=0, log_every=1,
+        mesh_shape=(args.procs, 1), batch_size=4, beam_size=2,
+        num_data_workers=2, max_eval_ann_num=8,
+    )
+    config.save(os.path.join(args.root, "config.json"))
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", "-c", WORKER,
+             REPO, str(p), str(args.procs), str(args.port), args.root],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for p in range(args.procs)
+    ]
+    ok = True
+    for p, proc in enumerate(procs):
+        out, _ = proc.communicate(timeout=900)
+        tail = "\n".join(out.strip().splitlines()[-6:])
+        print(f"--- process {p} (rc={proc.returncode}) ---\n{tail}", flush=True)
+        ok &= proc.returncode == 0
+
+    if not ok:
+        print("FAIL: a worker exited nonzero")
+        return 1
+
+    scores = [
+        json.load(open(os.path.join(args.root, f"scores_p{p}.json")))
+        for p in range(args.procs)
+    ]
+    if any(s != scores[0] for s in scores[1:]):
+        print("FAIL: hosts disagree on eval scores")
+        return 1
+    print(f"MULTIHOST OK: {args.procs} processes, scores agree: "
+          f"Bleu_4={scores[0]['Bleu_4']:.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

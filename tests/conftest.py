@@ -20,6 +20,16 @@ import jax  # noqa: E402
 # overrides JAX_PLATFORMS, so pin the platform via config too.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite compiles dozens of model/mesh
+# variants; caching them across runs cuts wall-clock several-fold.
+_cache_dir = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_cache",
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

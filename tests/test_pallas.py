@@ -46,6 +46,24 @@ def test_fused_attend_matches_reference(rng):
     np.testing.assert_allclose(np.asarray(got_alpha).sum(-1), 1.0, rtol=1e-6)
 
 
+@pytest.mark.parametrize("B,block_b", [(5, 4), (8, 8), (2, 8), (13, 4)])
+def test_fused_attend_batch_tiling(rng, B, block_b):
+    """Batch-tile grid: every (B, block_b) combination — including
+    non-divisible and B < block_b — must pad internally and match."""
+    N, da, D = 21, 16, 24
+    t1 = jnp.asarray(rng.normal(size=(B, N, da)).astype(np.float32))
+    t2 = jnp.asarray(rng.normal(size=(B, da)).astype(np.float32))
+    w2 = jnp.asarray(rng.normal(size=(da, 1)).astype(np.float32))
+    ctx = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+
+    want_ctx, want_alpha = fused_attend_reference(t1, t2, w2, ctx)
+    got_ctx, got_alpha = fused_attend(
+        t1, t2, w2, ctx, interpret=True, block_b=block_b
+    )
+    np.testing.assert_allclose(got_alpha, want_alpha, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(got_ctx, want_ctx, rtol=1e-5, atol=1e-5)
+
+
 @pytest.mark.parametrize("layers", [1, 2])
 def test_precomputed_attend_matches_plain(rng, layers):
     """Hoisting the context projection must be numerically exact in fp32."""

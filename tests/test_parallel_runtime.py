@@ -113,6 +113,76 @@ def test_mesh_eval_matches_single_device(coco_fixture, tmp_path):
     assert r1 == r2 and len(r1) > 0
 
 
+def test_multihost_decode_assembly_matches_single_host(coco_fixture, tmp_path):
+    """Simulate the 2-process mesh decode: per-host interleaved dataset
+    shards, per-host beam blocks stacked in process order (the
+    make_global_batch layout), then _assemble_mesh_results — captions must
+    equal the single-device decode_dataset output, padding rows and
+    process-duplicate rows dropped."""
+    from sat_tpu.data.dataset import prepare_eval_data
+    from sat_tpu.data.images import ImageLoader, PrefetchLoader
+    from sat_tpu.models.captioner import encode
+    from sat_tpu.ops.beam_search import beam_search_jit
+    from sat_tpu.parallel.data import pad_dataset_for_processes
+    from sat_tpu.runtime import _assemble_mesh_results, _eos_id, decode_dataset
+    from sat_tpu.train.step import create_train_state
+
+    config = coco_fixture["config"].replace(
+        **{**SMALL_MODEL, "beam_size": 2, "batch_size": 4}
+    )
+    coco, full_ds, vocab = prepare_eval_data(config)
+    # 5 images: exercises both the process pad (5→6) and per-host
+    # fake_count (3 local rows / local batch 2)
+    ds = DataSet(full_ds.image_ids[:5], full_ds.image_files[:5], 4)
+    config = config.replace(vocabulary_size=len(vocab.words))
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    eos = _eos_id(vocab)
+
+    want = decode_dataset(config, state, ds, vocab)
+
+    pc = 2
+    padded = pad_dataset_for_processes(ds, pc)
+    assert padded.count == 6
+    locals_ = [
+        process_local_dataset(padded, process_index=p, process_count=pc)
+        for p in range(pc)
+    ]
+    assert {l.count for l in locals_} == {3}
+
+    variables = {"params": state.params}
+    blocks = []           # blocks[h][b] = (words, lengths, scores)
+    for l in locals_:
+        loader = PrefetchLoader(l, ImageLoader(size=config.image_size), num_workers=2)
+        host_blocks = []
+        for batch in loader:
+            contexts, _ = encode(variables, config, batch["images"], train=False)
+            out = beam_search_jit(
+                state.params["decoder"], config, contexts, eos,
+                beam_size=config.beam_size, valid_size=len(vocab.words),
+            )
+            host_blocks.append(
+                (np.asarray(out.words[:, 0]), np.asarray(out.lengths[:, 0]),
+                 np.asarray(out.log_scores[:, 0]))
+            )
+        blocks.append(host_blocks)
+
+    num_batches = len(blocks[0])
+    gathered = [
+        tuple(
+            np.concatenate([blocks[h][b][k] for h in range(pc)], axis=0)
+            for k in range(3)
+        )
+        for b in range(num_batches)
+    ]
+    got = _assemble_mesh_results(ds, vocab, gathered, pc, locals_[0].count)
+
+    assert [r["image_id"] for r in got] == [r["image_id"] for r in want]
+    assert [r["caption"] for r in got] == [r["caption"] for r in want]
+    np.testing.assert_allclose(
+        [r["prob"] for r in got], [r["prob"] for r in want], rtol=1e-5
+    )
+
+
 def test_process_local_dataset_slices_disjointly():
     ids = np.arange(24)
     files = np.array([f"f{i}.jpg" for i in ids])
@@ -132,6 +202,24 @@ def test_process_local_dataset_slices_disjointly():
 
     with pytest.raises(ValueError, match="not divisible"):
         process_local_dataset(global_ds, process_index=0, process_count=3)
+
+
+def test_pad_dataset_for_processes_handles_pad_beyond_count():
+    """pad > count (tiny dataset, many hosts) must tile with modulo, not
+    silently under-pad into a non-divisible (→ empty-shard) dataset."""
+    from sat_tpu.parallel.data import pad_dataset_for_processes
+
+    ids = np.arange(3)
+    files = np.array([f"f{i}.jpg" for i in ids])
+    ds = DataSet(ids, files, 8)
+    padded = pad_dataset_for_processes(ds, 8)
+    assert padded.count == 8
+    assert set(padded.image_ids.tolist()) == set(ids.tolist())
+    shards = [
+        process_local_dataset(padded, process_index=p, process_count=8)
+        for p in range(8)
+    ]
+    assert all(s.count == 1 for s in shards)
 
 
 def test_process_local_dataset_equalizes_uneven_shards():

@@ -118,6 +118,27 @@ def test_cli_flag_parity():
     assert cli["load"] is True and cli["model_file"] == "/x/y.npz"
 
 
+def test_env_path_rerooting(monkeypatch):
+    """SAT_DATA_ROOT / SAT_LOG_ROOT re-root default paths (the reference's
+    clusterone get_data_path/get_logs_path capability); explicit --set
+    overrides are left alone."""
+    monkeypatch.setenv("SAT_DATA_ROOT", "/mnt/datasets")
+    monkeypatch.setenv("SAT_LOG_ROOT", "/mnt/experiments")
+    config, _ = build_config(
+        ["--phase=train", "--set", "train_image_dir=/my/custom/images"]
+    )
+    assert config.train_image_dir == "/my/custom/images"      # --set wins
+    assert config.train_caption_file == "/mnt/datasets/data/train/captions_train2014.json"
+    assert config.vocabulary_file == "/mnt/datasets/data/vocabulary.csv"
+    assert config.save_dir == "/mnt/experiments/data/models/"
+    assert config.summary_dir == "/mnt/experiments/summary/"
+
+    monkeypatch.delenv("SAT_DATA_ROOT")
+    monkeypatch.delenv("SAT_LOG_ROOT")
+    config, _ = build_config(["--phase=train"])
+    assert config.train_image_dir == "./data/train/images/"   # untouched
+
+
 def test_cli_rejects_unknown_field():
     with pytest.raises(SystemExit):
         build_config(["--set", "definitely_not_a_field=1"])
