@@ -73,7 +73,32 @@ def _program_flops(compiled) -> float | None:
         return None
 
 
+def _arm_watchdog() -> "callable":
+    """Hard deadline for the whole bench (BENCH_WATCHDOG_S, default 540s).
+
+    The tunneled TPU backend can wedge with jax.devices() blocking
+    uninterruptibly (observed this round: >2h); without a watchdog the
+    driver sees rc=124 and nothing else.  Failing fast with a clear stderr
+    tail is strictly more informative.  Returns a disarm callback."""
+    import threading
+
+    deadline = float(os.environ.get("BENCH_WATCHDOG_S", "540"))
+    done = threading.Event()
+
+    def monitor():
+        if not done.wait(deadline):
+            log(
+                f"WATCHDOG: bench did not finish within {deadline:.0f}s — "
+                "device backend unreachable or compile stuck; aborting"
+            )
+            os._exit(3)
+
+    threading.Thread(target=monitor, daemon=True).start()
+    return done.set
+
+
 def main() -> None:
+    disarm = _arm_watchdog()
     log("importing jax")
     import jax
 
@@ -171,6 +196,7 @@ def main() -> None:
             result["mfu"] = round(achieved / peak, 4)
     # THE contract line — flushed the moment the first window completes.
     print(json.dumps(result), flush=True)
+    disarm()
 
 
 if __name__ == "__main__":

@@ -188,7 +188,7 @@ class Config:
             for name in fields:
                 value = getattr(self, name)
                 if value and value == getattr(defaults, name):
-                    updates[name] = os.path.join(root, value.lstrip("./"))
+                    updates[name] = os.path.join(root, value.removeprefix("./"))
         return self.replace(**updates) if updates else self
 
     @property

@@ -148,7 +148,6 @@ def prepare_train_data(config: Config) -> DataSet:
 
         from ..utils.fileio import atomic_write
 
-        os.makedirs(os.path.dirname(config.temp_annotation_file) or ".", exist_ok=True)
         # atomic: concurrent processes (multi-host prep over a shared fs)
         # must never observe a half-written cache
         atomic_write(
@@ -177,7 +176,6 @@ def prepare_train_data(config: Config) -> DataSet:
             masks[i, :n_words] = 1.0
         from ..utils.fileio import atomic_write
 
-        os.makedirs(os.path.dirname(config.temp_data_file) or ".", exist_ok=True)
         atomic_write(
             config.temp_data_file,
             "wb",

@@ -86,12 +86,8 @@ def main() -> int:
         return 1
     speedup = t_xla / best[1]
     print(f"best pallas: block_b={best[0]}  speedup vs XLA: {speedup:.2f}x")
-    print(
-        "verdict: ENABLE use_pallas_attention"
-        if speedup > 1.05
-        else "verdict: keep XLA path (no win)"
-    )
-    # correctness cross-check on device
+    # correctness BEFORE the verdict: a fast-but-wrong kernel must never
+    # emit the ENABLE line
     want = fused_attend_reference(t1, t2, w2, ctx)
     got = fused_attend(t1, t2, w2, ctx, block_b=best[0])
     np.testing.assert_allclose(
@@ -101,6 +97,11 @@ def main() -> int:
         np.asarray(got[0]), np.asarray(want[0]), rtol=2e-4, atol=2e-4
     )
     print("on-device correctness: OK")
+    print(
+        "verdict: ENABLE use_pallas_attention"
+        if speedup > 1.05
+        else "verdict: keep XLA path (no win)"
+    )
     return 0
 
 

@@ -77,6 +77,8 @@ def main() -> int:
     )
     config.save(os.path.join(args.root, "config.json"))
 
+    import threading
+
     procs = [
         subprocess.Popen(
             [sys.executable, "-u", "-c", WORKER,
@@ -85,12 +87,34 @@ def main() -> int:
         )
         for p in range(args.procs)
     ]
+    # drain every pipe concurrently: a worker blocked on a full stdout
+    # pipe inside a collective would deadlock the whole cluster
+    outputs = [""] * args.procs
+
+    def drain(p, proc):
+        out, _ = proc.communicate()
+        outputs[p] = out or ""
+
+    threads = [
+        threading.Thread(target=drain, args=(p, proc), daemon=True)
+        for p, proc in enumerate(procs)
+    ]
     ok = True
-    for p, proc in enumerate(procs):
-        out, _ = proc.communicate(timeout=900)
-        tail = "\n".join(out.strip().splitlines()[-6:])
-        print(f"--- process {p} (rc={proc.returncode}) ---\n{tail}", flush=True)
-        ok &= proc.returncode == 0
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=900)
+        for p, proc in enumerate(procs):
+            rc = proc.returncode
+            tail = "\n".join(outputs[p].strip().splitlines()[-6:])
+            print(f"--- process {p} (rc={rc}) ---\n{tail}", flush=True)
+            ok &= rc == 0
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                ok = False
 
     if not ok:
         print("FAIL: a worker exited nonzero")
