@@ -1,0 +1,43 @@
+#!/bin/bash
+# One-shot TPU measurement session: run everything that needs the real
+# chip, in priority order, each stage logged. Usage:
+#   bash scripts/tpu_session.sh [outdir]
+set -u
+OUT=${1:-/tmp/tpu_session}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "=== stage 0: device probe ==="
+timeout 180 python -c "import jax; print(jax.devices())" || {
+  echo "TPU unreachable; aborting"; exit 3; }
+
+FAILED=""
+
+echo "=== stage 1: bench batch sweep (MFU) ==="
+for B in 32 64 128; do
+  echo "--- BENCH_BATCH=$B ---"
+  BENCH_BATCH=$B BENCH_WATCHDOG_S=480 timeout 500 python bench.py \
+    2>"$OUT/bench_B$B.log" | tee "$OUT/bench_B$B.json"
+  rc=${PIPESTATUS[0]}
+  if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_B$B.json" ]; then
+    echo "STAGE FAILED: bench B=$B (rc=$rc) — see $OUT/bench_B$B.log"
+    FAILED="$FAILED bench_B$B"
+  fi
+done
+
+echo "=== stage 2: pallas attention measurement ==="
+timeout 500 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
+rc=${PIPESTATUS[0]}
+[ "$rc" -ne 0 ] && { echo "STAGE FAILED: pallas (rc=$rc)"; FAILED="$FAILED pallas"; }
+
+echo "=== stage 3: flagship quality run ==="
+timeout 1200 python scripts/quality_run.py --steps 300 \
+  2>&1 | tee "$OUT/quality.txt" | tail -20
+rc=${PIPESTATUS[0]}
+[ "$rc" -ne 0 ] && { echo "STAGE FAILED: quality run (rc=$rc)"; FAILED="$FAILED quality"; }
+
+if [ -n "$FAILED" ]; then
+  echo "=== session finished with FAILED stages:$FAILED — artifacts in $OUT ==="
+  exit 1
+fi
+echo "=== session complete; artifacts in $OUT ==="

@@ -1,0 +1,29 @@
+"""Strip optimizer slots from a checkpoint for slim inference files.
+
+CLI twin of the reference's offline trim tool
+(/root/reference/data/models/trim_model.py:11-18) over our npz format.
+
+Usage: python scripts/trim_model.py in.npz out.npz
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sat_tpu.train.checkpoint import trim_checkpoint  # noqa: E402
+
+
+def main() -> int:
+    if len(sys.argv) != 3:
+        print(__doc__)
+        return 2
+    kept = trim_checkpoint(sys.argv[1], sys.argv[2])
+    print(f"{kept} entries kept -> {sys.argv[2]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
