@@ -1,0 +1,100 @@
+"""Eval-side decode throughput: images/sec at beam_size=3.
+
+BASELINE.md declares this a to-be-measured metric (the reference publishes
+none; its host-side beam loop does ~beam×20 sess.run round-trips per image,
+/root/reference/base_model.py:184-212).  Measures the full on-device
+pipeline per batch: VGG16 encode + batched beam-search scan, one dispatch.
+
+Usage: python scripts/bench_eval.py [--batch 32] [--beam 3] [--iters 20]
+       (add --cpu --image-size 64 for a smoke run off-TPU)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--beam", type=int, default=3)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        # both mechanisms: the env's sitecustomize imports jax itself and
+        # re-pins the platform (see tests/conftest.py)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+
+    import jax
+
+    from sat_tpu.config import Config
+    from sat_tpu.models.captioner import encode, init_variables
+    from sat_tpu.ops.beam_search import beam_search_jit
+
+    dev = jax.devices()[0]
+    print(f"device: {getattr(dev, 'device_kind', dev.platform)}", file=sys.stderr, flush=True)
+
+    config = Config(
+        batch_size=args.batch, beam_size=args.beam, image_size=args.image_size
+    )
+    B = args.batch
+    rng = np.random.default_rng(0)
+    images = jax.device_put(
+        rng.normal(size=(B, args.image_size, args.image_size, 3)).astype(np.float32)
+    )
+    variables = init_variables(jax.random.PRNGKey(0), config)
+    eos = 1  # any fixed vocab index; cost is termination-independent worst case
+
+    @jax.jit
+    def decode(variables, images):
+        contexts, _ = encode(variables, config, images, train=False)
+        return beam_search_jit(
+            variables["params"]["decoder"], config, contexts, eos,
+            beam_size=args.beam,
+        )
+
+    t0 = time.perf_counter()
+    out = decode(variables, images)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    print(f"compile+first: {compile_s:.1f}s", file=sys.stderr, flush=True)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out = decode(variables, images)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+
+    images_per_sec = args.iters * B / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "eval_images_per_sec",
+                "value": round(images_per_sec, 2),
+                "unit": f"images/sec @ beam={args.beam}",
+                "batch_size": B,
+                "batch_ms": round(1e3 * elapsed / args.iters, 1),
+                "device_kind": getattr(dev, "device_kind", dev.platform),
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

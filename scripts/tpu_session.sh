@@ -25,6 +25,14 @@ for B in 32 64 128; do
   fi
 done
 
+echo "=== stage 1b: eval decode throughput (beam=3) ==="
+timeout 500 python scripts/bench_eval.py 2>"$OUT/bench_eval.log" \
+  | tee "$OUT/bench_eval.json"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_eval.json" ]; then
+  echo "STAGE FAILED: bench_eval (rc=$rc)"; FAILED="$FAILED bench_eval"
+fi
+
 echo "=== stage 2: pallas attention measurement ==="
 timeout 500 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
 rc=${PIPESTATUS[0]}
