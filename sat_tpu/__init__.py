@@ -10,6 +10,16 @@ SPMD data/context-parallel training over a jax.sharding.Mesh.
 
 from .config import Config
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
-__all__ = ["Config"]
+__all__ = ["Config", "train", "evaluate", "test", "evaluate_sweep"]
+
+
+def __getattr__(name: str):
+    # lazy: the runtime pulls in jax; `import sat_tpu` for Config alone
+    # (host-side tooling, config parsing) stays light
+    if name in ("train", "evaluate", "test", "evaluate_sweep"):
+        from . import runtime
+
+        return getattr(runtime, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
