@@ -31,6 +31,9 @@ sys.path.insert(0, repo)
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
+# share the repo's persistent compile cache across workers/reruns
+jax.config.update("jax_compilation_cache_dir", os.path.join(repo, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 from sat_tpu.parallel import initialize_distributed
 initialize_distributed(
@@ -77,13 +80,27 @@ def main() -> int:
     )
     config.save(os.path.join(args.root, "config.json"))
 
+    import re
     import threading
+
+    # each worker must see exactly ONE local CPU device: an inherited
+    # --xla_force_host_platform_device_count (e.g. from the test harness)
+    # would give every process N devices and break the device↔process map
+    env = dict(os.environ)
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    ).strip()
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=1"
+    ).strip()
 
     procs = [
         subprocess.Popen(
             [sys.executable, "-u", "-c", WORKER,
              REPO, str(p), str(args.procs), str(args.port), args.root],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
         )
         for p in range(args.procs)
     ]
