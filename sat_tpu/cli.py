@@ -66,10 +66,22 @@ def build_config(argv: Optional[List[str]] = None):
     )
     p.add_argument("--beam_size", type=int, default=3)
     p.add_argument(
+        "--sweep", action="store_true",
+        help="eval phase: score EVERY checkpoint under save_dir "
+             "(the reference's eval.sh loop), writing <step>.txt dumps",
+    )
+    p.add_argument(
         "--set", action="append", default=[], metavar="KEY=VALUE",
         help="override any Config field, repeatable",
     )
     args = p.parse_args(argv)
+    if args.sweep and args.phase != "eval":
+        raise SystemExit("--sweep only applies to --phase=eval")
+    if args.sweep and (args.model_file or args.load):
+        raise SystemExit(
+            "--sweep scores every checkpoint under save_dir; it conflicts "
+            "with --model_file/--load"
+        )
 
     config = Config(
         phase=args.phase,
@@ -93,6 +105,7 @@ def build_config(argv: Optional[List[str]] = None):
         "model_file": args.model_file,
         "load_cnn": args.load_cnn,
         "cnn_model_file": args.cnn_model_file,
+        "sweep": args.sweep,
     }
     return config, cli
 
@@ -118,6 +131,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         runtime.train(config, state=state)
     elif config.phase == "eval":
+        if cli["sweep"]:
+            sweep = runtime.evaluate_sweep(config)
+            for step in sorted(sweep):
+                line = "  ".join(f"{k}={v:.4f}" for k, v in sweep[step].items())
+                print(f"step {step}: {line}")
+            return 0
         state = runtime.setup_state(
             config, load=True, model_file=cli["model_file"]
         )

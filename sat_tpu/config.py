@@ -117,6 +117,24 @@ class Config:
     profile_num_steps: int = 3         # steps captured per trace
     global_step: int = 0               # persisted into checkpoints
 
+    def __post_init__(self) -> None:
+        """Fail fast on knob typos — a wrong ``cnn`` string would otherwise
+        silently select a different model (the reference's if/else does the
+        same, /root/reference/model.py:16-21)."""
+        checks = (
+            ("cnn", ("vgg16", "resnet50")),
+            ("phase", ("train", "eval", "test")),
+            ("optimizer", ("Adam", "RMSProp", "Momentum", "SGD")),
+            ("num_initialize_layers", (1, 2)),
+            ("num_attend_layers", (1, 2)),
+            ("num_decode_layers", (1, 2)),
+        )
+        for name, allowed in checks:
+            if getattr(self, name) not in allowed:
+                raise ValueError(
+                    f"Config.{name}={getattr(self, name)!r}: must be one of {allowed}"
+                )
+
     def replace(self, **kw: Any) -> "Config":
         return dataclasses.replace(self, **kw)
 

@@ -31,7 +31,9 @@ def make_encoder(config: Config):
     dtype = jnp.dtype(config.compute_dtype)
     if config.cnn == "vgg16":
         return VGG16(dtype=dtype)
-    return ResNet50(dtype=dtype)
+    if config.cnn == "resnet50":
+        return ResNet50(dtype=dtype)
+    raise ValueError(f"unknown cnn {config.cnn!r} (vgg16 or resnet50)")
 
 
 def init_variables(rng: jax.Array, config: Config) -> Dict[str, Any]:

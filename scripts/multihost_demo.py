@@ -62,6 +62,10 @@ def main() -> int:
     ap.add_argument("--procs", type=int, default=2)
     ap.add_argument("--port", type=int, default=12765)
     ap.add_argument("--root", default="/tmp/sat_tpu_multihost_demo")
+    ap.add_argument(
+        "--join-timeout", type=float, default=900.0,
+        help="seconds to wait for the workers before declaring failure",
+    )
     args = ap.parse_args()
 
     sys.path.insert(0, REPO)
@@ -121,7 +125,7 @@ def main() -> int:
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=900)
+            t.join(timeout=args.join_timeout)
         for p, proc in enumerate(procs):
             rc = proc.returncode
             tail = "\n".join(outputs[p].strip().splitlines()[-6:])

@@ -210,19 +210,32 @@ def test_multihost_demo_two_real_processes(tmp_path):
     per-host data shards, and run multi-host mesh eval with cross-host
     result gather — both hosts must finish rc=0 with identical scores."""
     import os
+    import signal
+    import socket
     import subprocess
     import sys
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    r = subprocess.run(
+    with socket.socket() as s:  # free coordinator port (xdist/CI safe)
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
         [
             sys.executable, os.path.join(repo, "scripts", "multihost_demo.py"),
-            "--root", str(tmp_path / "demo"), "--port", "12931",
+            "--root", str(tmp_path / "demo"), "--port", str(port),
+            "--join-timeout", "420",
         ],
-        capture_output=True, text=True, timeout=600, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=repo,
+        start_new_session=True,  # own process group: timeout kills workers too
     )
-    assert r.returncode == 0, r.stdout[-3000:]
-    assert "MULTIHOST OK" in r.stdout
+    try:
+        out, err = proc.communicate(timeout=540)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        out, err = proc.communicate()
+        raise AssertionError(f"demo timed out\n{out[-2000:]}\n{err[-1500:]}")
+    assert proc.returncode == 0, f"{out[-3000:]}\n--- stderr ---\n{err[-1500:]}"
+    assert "MULTIHOST OK" in out
 
 
 def test_pad_dataset_for_processes_handles_pad_beyond_count():

@@ -139,6 +139,40 @@ def test_env_path_rerooting(monkeypatch):
     assert config.train_image_dir == "./data/train/images/"   # untouched
 
 
+def test_config_rejects_knob_typos():
+    from sat_tpu.config import Config
+
+    with pytest.raises(ValueError, match="cnn"):
+        Config(cnn="alexnet")
+    with pytest.raises(ValueError, match="optimizer"):
+        Config(optimizer="adam")  # case-sensitive, like the reference
+    with pytest.raises(ValueError, match="num_attend_layers"):
+        Config(num_attend_layers=3)
+    with pytest.raises(ValueError, match="phase"):
+        build_config(["--set", "phase=evaluate"])
+
+
+def test_cli_eval_sweep(trained, capsys):
+    config, _ = trained
+    from sat_tpu.cli import main
+
+    args = ["--phase=eval", "--sweep", "--beam_size=2"] + [
+        x
+        for k, v in config.to_dict().items()
+        if isinstance(v, (str, int, float, bool)) and v != ""
+        and k in ("save_dir", "summary_dir", "eval_image_dir",
+                  "eval_caption_file", "vocabulary_file", "eval_result_dir",
+                  "eval_result_file", "batch_size", "vocabulary_size",
+                  "image_size", "dim_embedding", "num_lstm_units",
+                  "dim_initialize_layer", "dim_attend_layer",
+                  "dim_decode_layer", "compute_dtype", "max_eval_ann_num")
+        for x in ("--set", f"{k}={v}")
+    ]
+    assert main(args) == 0
+    out = capsys.readouterr().out
+    assert "step 3:" in out and "step 6:" in out and "Bleu_4=" in out
+
+
 def test_cli_rejects_unknown_field():
     with pytest.raises(SystemExit):
         build_config(["--set", "definitely_not_a_field=1"])
