@@ -12,14 +12,9 @@ from .config import Config
 
 __version__ = "0.2.0"
 
-__all__ = ["Config", "train", "evaluate", "test", "evaluate_sweep"]
-
-
-def __getattr__(name: str):
-    # lazy: the runtime pulls in jax; `import sat_tpu` for Config alone
-    # (host-side tooling, config parsing) stays light
-    if name in ("train", "evaluate", "test", "evaluate_sweep"):
-        from . import runtime
-
-        return getattr(runtime, name)
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+# The driving loops live in sat_tpu.runtime (train/evaluate/test/
+# evaluate_sweep).  They are deliberately NOT re-exported here: the
+# ``sat_tpu.train`` *subpackage* (optimizer/checkpoint/step) would shadow a
+# ``train`` function attribute as soon as runtime imports it, making the
+# name order-dependent.  ``from sat_tpu import runtime`` is the API.
+__all__ = ["Config"]

@@ -319,12 +319,17 @@ def decode_dataset(
     results: List[Dict[str, Any]] = []
     seen = set()
     emitted = 0
-    for batch in loader:
-        out = run_batch(batch)
+    # depth-1 pipeline: dispatch batch n+1 to the device before fetching
+    # batch n's results, so host-side decode of words/captions overlaps
+    # device-side beam search (np.asarray is the sync point)
+    prev: Optional[Tuple[Any, List[str]]] = None
+
+    def drain(out, files):
+        nonlocal emitted
         words = np.asarray(out.words[:, 0])        # best caption per image
         lengths = np.asarray(out.lengths[:, 0])
         scores = np.asarray(out.log_scores[:, 0])
-        for i, image_file in enumerate(batch["files"]):
+        for i, image_file in enumerate(files):
             if emitted >= dataset.count:           # fake_count padding
                 break
             # eval/test DataSets are unshuffled, so batch order is
@@ -344,6 +349,14 @@ def decode_dataset(
                     "prob": float(np.exp(scores[i])),
                 }
             )
+
+    for batch in loader:
+        out = run_batch(batch)                     # async dispatch
+        if prev is not None:
+            drain(*prev)
+        prev = (out, batch["files"])
+    if prev is not None:
+        drain(*prev)
     return results
 
 
