@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import glob
 import os
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 

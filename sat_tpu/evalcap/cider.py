@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import math
 from collections import Counter, defaultdict
-from typing import Dict, List, Tuple
+from typing import Dict, Tuple
 
 import numpy as np
 

@@ -10,7 +10,7 @@ per-image scores.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from ..data.coco import CocoCaptions
 from ..data.tokenizer import tokenize_captions

@@ -39,7 +39,6 @@ from ..models.decoder import (
     _dense,
     _dropout,
     decode_logits,
-    init_state,
     lstm_step,
 )
 from ..train.step import TrainState, split_trainable

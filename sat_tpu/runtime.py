@@ -22,7 +22,6 @@ import os
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from .config import Config
@@ -30,12 +29,11 @@ from .data.dataset import DataSet, prepare_eval_data, prepare_test_data, prepare
 from .data.images import ImageLoader, PrefetchLoader
 from .data.vocabulary import Vocabulary
 from .evalcap.eval import CocoEvalCap
-from .models.captioner import encode, init_variables
+from .models.captioner import encode
 from .ops.beam_search import beam_search_jit
 from .train.checkpoint import (
     apply_cnn_import,
     import_reference_checkpoint,
-    latest_checkpoint,
     restore_checkpoint,
     save_checkpoint,
 )

@@ -15,7 +15,6 @@ inserts the gradient all-reduce.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
