@@ -92,6 +92,10 @@ class Config:
     eval_result_dir: str = "./data/val/results/"
     eval_result_file: str = "./data/val/results.json"
     save_eval_result_as_image: bool = False
+    # per-word attention-map panels next to each captioned image (the
+    # paper's signature figure; the reference never exposes decode-time
+    # attention).  Honored by eval/test on single-device runs.
+    save_attention_maps: bool = False
 
     # ---- testing paths (reference config.py:83-85) ----
     test_image_dir: str = "./data/test/images/"

@@ -58,6 +58,10 @@ class BeamResult(NamedTuple):
     words: jnp.ndarray      # [B, K, T] int32 token ids ('.'-terminated)
     log_scores: jnp.ndarray  # [B, K] sum of log p(word) — product ordering
     lengths: jnp.ndarray    # [B, K] int32 number of emitted tokens
+    # [B, K, T, N] per-word attention maps of each returned caption
+    # (soft-attention α over the context grid at the step that emitted
+    # word t); None unless return_alphas was set
+    alphas: Optional[jnp.ndarray] = None
 
 
 def beam_search(
@@ -69,6 +73,7 @@ def beam_search(
     max_len: Optional[int] = None,
     valid_size: Optional[int] = None,
     hoist_attention: bool = True,
+    return_alphas: bool = False,
 ) -> BeamResult:
     """Decode captions for a batch of context grids.
 
@@ -82,6 +87,9 @@ def beam_search(
     hoist_attention: precompute the context half of the attention MLP
       outside the decode loop (inference-exact; False keeps the
       step-by-step oracle path for testing).
+    return_alphas: also carry each hypothesis's per-step attention maps
+      through the search (the paper's per-word attention figures; neither
+      the reference nor its upstream exposes them at decode time).
     """
     K = beam_size or config.beam_size
     T = max_len or config.max_caption_length
@@ -115,16 +123,23 @@ def beam_search(
     fin_words = jnp.zeros((B, K, T), jnp.int32)
     fin_len = jnp.zeros((B, K), jnp.int32)
 
+    # per-step attention maps of every hypothesis; zero-width unless
+    # requested, so the carry copies cost nothing in the default path
+    An = N if return_alphas else 0
+    live_alphas = jnp.zeros((B, K, T, An), jnp.float32)
+    fin_alphas = jnp.zeros((B, K, T, An), jnp.float32)
+
     batch_idx = jnp.arange(B)[:, None]  # [B,1] for beam gathers
 
     def body(carry, t):
         (state, live_logp, live_words, live_len, last_word,
-         fin_logp, fin_words, fin_len) = carry
+         fin_logp, fin_words, fin_len, live_alphas, fin_alphas) = carry
 
-        new_state, logits, _ = decoder_step(
+        new_state, logits, alpha = decoder_step(
             params, config, ctx_tiled, state, last_word.reshape(B * K),
             train=False, ctx_proj=proj_tiled,
         )
+        step_alpha = alpha.reshape(B, K, N)[:, :, :An]          # [B,K,An]
         if valid_size is not None and valid_size < V:
             logits = logits.at[:, valid_size:].set(NEG_INF)
         step_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -142,13 +157,17 @@ def beam_search(
             jnp.full((B, K), eos_id, jnp.int32)
         )
         eos_len = live_len + 1
+        # the eos word was emitted from THIS step's attention
+        eos_alphas = live_alphas.at[:, :, t].set(step_alpha)
         cand_logp = jnp.concatenate([fin_logp, eos_scores], axis=1)      # [B,2K]
         cand_words = jnp.concatenate([fin_words, eos_words], axis=1)     # [B,2K,T]
         cand_len = jnp.concatenate([fin_len, eos_len], axis=1)
+        cand_alphas = jnp.concatenate([fin_alphas, eos_alphas], axis=1)
         top_fin, fin_sel = jax.lax.top_k(cand_logp, K)
         fin_logp = top_fin
         fin_words = cand_words[batch_idx, fin_sel]
         fin_len = cand_len[batch_idx, fin_sel]
+        fin_alphas = cand_alphas[batch_idx, fin_sel]
 
         # --- continuations: global top-K over beam×vocab, eos excluded
         cont = logp.at[:, :, eos_id].set(NEG_INF).reshape(B, K * V)
@@ -164,17 +183,20 @@ def beam_search(
         )
         live_words = live_words[batch_idx, parent].at[:, :, t].set(word)
         live_len = live_len[batch_idx, parent] + 1
+        live_alphas = live_alphas[batch_idx, parent].at[:, :, t].set(
+            step_alpha[batch_idx, parent]
+        )
         live_logp = top_live
         last_word = word
 
         return (state, live_logp, live_words, live_len, last_word,
-                fin_logp, fin_words, fin_len), None
+                fin_logp, fin_words, fin_len, live_alphas, fin_alphas), None
 
     carry = (state, live_logp, live_words, live_len, last_word,
-             fin_logp, fin_words, fin_len)
+             fin_logp, fin_words, fin_len, live_alphas, fin_alphas)
     carry, _ = jax.lax.scan(body, carry, jnp.arange(T))
     (_, live_logp, live_words, live_len, _,
-     fin_logp, fin_words, fin_len) = carry
+     fin_logp, fin_words, fin_len, live_alphas, fin_alphas) = carry
 
     # Merge: completed captions first (the reference only falls back to
     # partials when NOTHING completed, base_model.py:236-237); any fin
@@ -189,22 +211,32 @@ def beam_search(
     cand_words = jnp.concatenate([fin_words, live_words], axis=1)
     cand_len = jnp.concatenate([fin_len, live_len], axis=1)
     _, sel = jax.lax.top_k(rank_key, K)                     # [B,K]
+    alphas = None
+    if return_alphas:
+        cand_alphas = jnp.concatenate([fin_alphas, live_alphas], axis=1)
+        alphas = cand_alphas[batch_idx, sel]
     return BeamResult(
         words=cand_words[batch_idx, sel],
         log_scores=cand_logp[batch_idx, sel],
         lengths=cand_len[batch_idx, sel],
+        alphas=alphas,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("config", "eos_id", "beam_size", "max_len", "valid_size"),
+    static_argnames=(
+        "config", "eos_id", "beam_size", "max_len", "valid_size",
+        "return_alphas",
+    ),
 )
 def beam_search_jit(
-    params, config, contexts, eos_id, beam_size=None, max_len=None, valid_size=None
+    params, config, contexts, eos_id, beam_size=None, max_len=None,
+    valid_size=None, return_alphas=False,
 ):
     return beam_search(
-        params, config, contexts, eos_id, beam_size, max_len, valid_size
+        params, config, contexts, eos_id, beam_size, max_len, valid_size,
+        return_alphas=return_alphas,
     )
 
 

@@ -238,6 +238,11 @@ def decode_dataset(
     # each process feeds its shard of the dataset and the beam results are
     # all-gathered so every host assembles the full result list.
     if int(np.prod(config.mesh_shape)) > 1:
+        if config.save_attention_maps:
+            raise ValueError(
+                "save_attention_maps is a single-device eval/test feature; "
+                "run with mesh_shape=1,1 to render attention panels"
+            )
         from .parallel import make_mesh
         from .parallel.collectives import make_global_batch
         from .parallel.data import pad_dataset_for_processes, process_local_dataset
@@ -305,6 +310,7 @@ def decode_dataset(
                 state.params["decoder"], config, contexts, eos,
                 beam_size=config.beam_size,
                 valid_size=len(vocabulary.words),
+                return_alphas=config.save_attention_maps,
             )
 
     loader = PrefetchLoader(
@@ -327,6 +333,9 @@ def decode_dataset(
         words = np.asarray(out.words[:, 0])        # best caption per image
         lengths = np.asarray(out.lengths[:, 0])
         scores = np.asarray(out.log_scores[:, 0])
+        alphas = (
+            np.asarray(out.alphas[:, 0]) if out.alphas is not None else None
+        )
         for i, image_file in enumerate(files):
             if emitted >= dataset.count:           # fake_count padding
                 break
@@ -338,15 +347,20 @@ def decode_dataset(
             if image_id in seen:                   # reference's set() dedup
                 continue
             seen.add(image_id)
-            caption = vocabulary.get_sentence(words[i, : max(1, int(lengths[i]))])
-            results.append(
-                {
-                    "image_id": image_id,
-                    "image_file": str(image_file),
-                    "caption": caption,
-                    "prob": float(np.exp(scores[i])),
-                }
-            )
+            length = max(1, int(lengths[i]))
+            caption = vocabulary.get_sentence(words[i, :length])
+            row = {
+                "image_id": image_id,
+                "image_file": str(image_file),
+                "caption": caption,
+                "prob": float(np.exp(scores[i])),
+            }
+            if alphas is not None:
+                row["words"] = [
+                    vocabulary.words[w] for w in words[i, :length]
+                ]
+                row["alphas"] = alphas[i, :length]    # [len, N]
+            results.append(row)
 
     for batch in loader:
         out = run_batch(batch)                     # async dispatch
@@ -413,6 +427,64 @@ def _assemble_mesh_results(
     return results
 
 
+def _render_attention_panel(
+    image_file: str,
+    words: List[str],
+    alphas: np.ndarray,
+    out_file: str,
+) -> None:
+    """Per-word attention figure (Xu et al. fig. 5): the image, then one
+    tile per generated word with its soft-attention map α upsampled from
+    the context grid and overlaid.  alphas: [len(words), N], N a square
+    grid (196 → 14×14 for VGG16, 49 → 7×7 for ResNet50)."""
+    import cv2
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    img = plt.imread(image_file)
+    h, w = img.shape[:2]
+    g = int(round(np.sqrt(alphas.shape[1])))
+    # one shared color scale across the caption: per-tile autoscaling
+    # would stretch a near-uniform map to the same contrast as a sharply
+    # peaked one, faking localization
+    vmax = float(alphas.max()) or 1.0
+    n = len(words) + 1
+    cols = min(5, n)
+    rows = -(-n // cols)
+    fig, axes = plt.subplots(rows, cols, figsize=(2.2 * cols, 2.4 * rows))
+    axes = np.atleast_1d(axes).ravel()
+    axes[0].imshow(img)
+    axes[0].set_title("input", fontsize=8)
+    for t, word in enumerate(words):
+        ax = axes[t + 1]
+        amap = cv2.resize(
+            alphas[t].reshape(g, g).astype(np.float32), (w, h),
+            interpolation=cv2.INTER_CUBIC,
+        )
+        ax.imshow(img)
+        ax.imshow(amap, alpha=0.6, cmap="jet", vmin=0.0, vmax=vmax)
+        ax.set_title(word, fontsize=8)
+    for ax in axes:
+        ax.axis("off")
+    fig.tight_layout()
+    fig.savefig(out_file, dpi=110)
+    plt.close(fig)
+
+
+def _save_attention_panels(results: List[Dict[str, Any]], out_dir: str) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    for r in results:
+        if "alphas" not in r:
+            continue
+        stem = os.path.splitext(os.path.basename(r["image_file"]))[0]
+        _render_attention_panel(
+            r["image_file"], r["words"], r["alphas"],
+            os.path.join(out_dir, f"{stem}_attention.jpg"),
+        )
+
+
 def _render_caption_image(image_file: str, caption: str, out_file: str) -> None:
     """Captioned-JPG artifact (reference base_model.py:96-107)."""
     import matplotlib
@@ -463,6 +535,8 @@ def evaluate(
                 r["image_file"], r["caption"],
                 os.path.join(config.eval_result_dir, f"{stem}_result.jpg"),
             )
+    if config.save_attention_maps:
+        _save_attention_panels(results, config.eval_result_dir)
 
     coco_res = coco.load_results(payload)
     scorer = CocoEvalCap(coco, coco_res, eval_data=dataset)
@@ -523,6 +597,8 @@ def test(
             r["image_file"], r["caption"],
             os.path.join(config.test_result_dir, f"{stem}_result.jpg"),
         )
+    if config.save_attention_maps:
+        _save_attention_panels(results, config.test_result_dir)
 
     import pandas as pd
 

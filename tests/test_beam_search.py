@@ -261,3 +261,41 @@ class TestFallback:
         r1 = beam_search(params, cfg, contexts, eos_id=EOS, beam_size=1)
         r2 = greedy_decode(params, cfg, contexts, eos_id=EOS)
         np.testing.assert_array_equal(np.asarray(r1.words), np.asarray(r2.words))
+
+
+def test_returned_alphas_match_teacher_forced_replay():
+    """The winning caption's attention maps must equal the alphas obtained
+    by replaying that exact word sequence through decoder_step — pins the
+    per-step parent-gather bookkeeping of the alpha carry."""
+    cfg, params, contexts = setup(seed=5, B=3)
+    out = beam_search(params, cfg, contexts, EOS, return_alphas=True)
+    B, K, T, N = out.alphas.shape
+    assert (B, K, T, N) == (3, 3, cfg.max_caption_length, cfg.num_ctx)
+
+    for b in range(B):
+        for k in range(K):
+            words = np.asarray(out.words[b, k])
+            length = int(out.lengths[b, k])
+            state = init_state(params, cfg, contexts[b : b + 1], train=False)
+            for t in range(length):
+                last = 0 if t == 0 else int(words[t - 1])
+                state, _, alpha = decoder_step(
+                    params, cfg, contexts[b : b + 1], state,
+                    jnp.asarray([last], jnp.int32), train=False,
+                )
+                np.testing.assert_allclose(
+                    np.asarray(out.alphas[b, k, t]),
+                    np.asarray(alpha[0]),
+                    rtol=1e-5, atol=1e-6,
+                    err_msg=f"b={b} k={k} t={t}",
+                )
+            # rows sum to 1 inside the caption, stay zero past its end
+            sums = np.asarray(out.alphas[b, k]).sum(-1)
+            np.testing.assert_allclose(sums[:length], 1.0, rtol=1e-5)
+            np.testing.assert_allclose(sums[length:], 0.0, atol=1e-7)
+
+
+def test_alphas_off_by_default_and_costless():
+    cfg, params, contexts = setup(seed=3, B=2)
+    out = beam_search(params, cfg, contexts, EOS)
+    assert out.alphas is None

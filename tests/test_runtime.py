@@ -334,6 +334,30 @@ def test_variable_stats_include_histograms(tmp_path):
     assert struct.pack("<d", 101.0) in histo_rec
 
 
+def test_eval_renders_attention_panels(trained, tmp_path):
+    """save_attention_maps: per-word attention figures land next to the
+    eval results and each result row carries normalized [len, N] maps."""
+    config, state = trained
+    config = config.replace(
+        save_attention_maps=True,
+        eval_result_dir=str(tmp_path / "attn"),
+        eval_result_file=str(tmp_path / "attn.json"),
+    )
+    from sat_tpu.runtime import decode_dataset
+    from sat_tpu.data.dataset import prepare_eval_data
+
+    runtime.evaluate(config, state=state)
+    panels = [f for f in os.listdir(tmp_path / "attn") if f.endswith("_attention.jpg")]
+    assert panels, "no attention panels rendered"
+
+    _, ds, vocab = prepare_eval_data(config)
+    rows = decode_dataset(config, state, ds, vocab)
+    for r in rows:
+        assert len(r["words"]) == r["alphas"].shape[0]
+        assert r["alphas"].shape[1] == config.num_ctx
+        np.testing.assert_allclose(r["alphas"].sum(-1), 1.0, rtol=1e-4)
+
+
 def test_eval_sweep_scores_every_checkpoint(trained):
     config, _ = trained
     sweep = runtime.evaluate_sweep(config)
