@@ -111,7 +111,6 @@ class Config:
     context_parallel: int = 1          # shard the context grid over 'model'
     prefetch_depth: int = 2            # host→HBM async pipeline depth
     use_pallas_attention: bool = False # fused pallas soft-attention kernel
-    decode_on_device: bool = True      # lax.scan beam search vs host loop
     num_data_workers: int = 8          # image-decode thread pool
     log_every: int = 10                # metric-writer cadence (steps)
     var_summary_period: int = 0        # per-variable stats cadence (0=off)
