@@ -77,6 +77,14 @@ def param_partition_specs(params: Any, config: Config, mesh: Mesh) -> Any:
     )
 
 
+def named_shardings(tree: Any, config: Config, mesh: Mesh) -> Any:
+    """NamedSharding pytree for ANY pytree of arrays (params, a variables
+    dict, opt_state) under the standard placement rules."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_partition_specs(tree, config, mesh)
+    )
+
+
 def train_state_shardings(state: TrainState, config: Config, mesh: Mesh) -> TrainState:
     """NamedSharding pytree with TrainState structure.  ``state`` may be a
     concrete TrainState or the jax.eval_shape abstraction of one."""

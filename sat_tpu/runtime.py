@@ -246,7 +246,7 @@ def decode_dataset(
         from .parallel import make_mesh
         from .parallel.collectives import make_global_batch
         from .parallel.data import pad_dataset_for_processes, process_local_dataset
-        from .parallel.sharding import replicated
+        from .parallel.sharding import named_shardings
         from .parallel.train import make_parallel_beam_search
 
         mesh = make_mesh(config)
@@ -256,7 +256,13 @@ def decode_dataset(
                 f"batch_size={config.batch_size} not divisible by the "
                 f"data-axis size {dp} for mesh decoding"
             )
-        variables = jax.device_put(variables, replicated(mesh))
+        # vocab-TP placement, same rules as training: the embedding table
+        # and softmax projection shard over 'model' instead of idling it,
+        # and GSPMD compiles the TP decode (sharded logits, collective
+        # softmax/top-k) from the shardings alone
+        variables = jax.device_put(
+            variables, named_shardings(variables, config, mesh)
+        )
         caption_fn = make_parallel_beam_search(
             config, mesh, eos,
             beam_size=config.beam_size,

@@ -85,10 +85,12 @@ def test_mesh_and_single_device_training_agree(coco_fixture, tmp_path):
     np.testing.assert_allclose(b, a, rtol=5e-2)
 
 
-def test_mesh_eval_matches_single_device(coco_fixture, tmp_path):
+@pytest.mark.parametrize("mesh_shape", [(2, 1), (1, 2), (2, 2)])
+def test_mesh_eval_matches_single_device(coco_fixture, tmp_path, mesh_shape):
     """decode_dataset routes through make_parallel_beam_search on a mesh;
-    parallel eval must produce the SAME captions and scores as the
-    single-device path end-to-end (VERDICT r1 item 5)."""
+    parallel eval — dp-only, vocab-TP-only (embedding/softmax sharded over
+    'model'), and combined — must produce the SAME captions and scores as
+    the single-device path end-to-end (VERDICT r1 item 5)."""
     base = coco_fixture["config"].replace(
         **{**SMALL_MODEL,
            "save_dir": str(tmp_path / "models"),
@@ -98,9 +100,25 @@ def test_mesh_eval_matches_single_device(coco_fixture, tmp_path):
     )
     state = runtime.train(base.replace(mesh_shape=(1, 1)))
 
+    if mesh_shape[1] > 1:
+        # the TP variants must actually shard: the placement rule keys on
+        # config.vocabulary_size (param logit width), which divides the
+        # model axis here — guard against silently-replicated 'TP'
+        from sat_tpu.parallel import make_mesh
+        from sat_tpu.parallel.sharding import param_partition_specs
+
+        cfg_m = base.replace(mesh_shape=mesh_shape)
+        specs = param_partition_specs(
+            {"params": state.params}, cfg_m, make_mesh(cfg_m)
+        )
+        flat = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda s: "model" in str(s), specs)
+        )
+        assert any(flat), "vocab-TP rule placed nothing on the model axis"
+
     single = runtime.evaluate(base.replace(mesh_shape=(1, 1)), state=state)
     mesh = runtime.evaluate(
-        base.replace(mesh_shape=(2, 1), eval_result_file=str(tmp_path / "res2.json")),
+        base.replace(mesh_shape=mesh_shape, eval_result_file=str(tmp_path / "res2.json")),
         state=state,
     )
     assert single.keys() == mesh.keys()
