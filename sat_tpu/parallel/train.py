@@ -65,6 +65,7 @@ def make_parallel_beam_search(
     eos_id: int,
     beam_size: Optional[int] = None,
     valid_size: Optional[int] = None,
+    return_alphas: bool = False,
 ) -> Callable[[Dict[str, Any], Any], BeamResult]:
     """Jitted (variables, images) -> BeamResult, batch sharded over 'data'.
 
@@ -79,11 +80,15 @@ def make_parallel_beam_search(
         contexts, _ = encode(variables, config, images, train=False)
         return beam_search(
             variables["params"]["decoder"], config, contexts, eos_id,
-            beam_size=K, valid_size=valid_size,
+            beam_size=K, valid_size=valid_size, return_alphas=return_alphas,
         )
 
+    out_sh = batch_sharding(mesh)
     return jax.jit(
         caption,
-        in_shardings=(None, batch_sharding(mesh)),
-        out_shardings=batch_sharding(mesh),
+        in_shardings=(None, out_sh),
+        out_shardings=BeamResult(
+            words=out_sh, log_scores=out_sh, lengths=out_sh,
+            alphas=out_sh if return_alphas else None,
+        ),
     )

@@ -238,10 +238,11 @@ def decode_dataset(
     # each process feeds its shard of the dataset and the beam results are
     # all-gathered so every host assembles the full result list.
     if int(np.prod(config.mesh_shape)) > 1:
-        if config.save_attention_maps:
+        if config.save_attention_maps and jax.process_count() > 1:
             raise ValueError(
-                "save_attention_maps is a single-device eval/test feature; "
-                "run with mesh_shape=1,1 to render attention panels"
+                "save_attention_maps needs single-process eval (the [B,K,T,N]"
+                " alpha gather across hosts is not wired); mesh decoding on "
+                "one host supports it"
             )
         from .parallel import make_mesh
         from .parallel.collectives import make_global_batch
@@ -267,6 +268,7 @@ def decode_dataset(
             config, mesh, eos,
             beam_size=config.beam_size,
             valid_size=len(vocabulary.words),
+            return_alphas=config.save_attention_maps,
         )
 
         def run_batch(batch):

@@ -334,12 +334,15 @@ def test_variable_stats_include_histograms(tmp_path):
     assert struct.pack("<d", 101.0) in histo_rec
 
 
-def test_eval_renders_attention_panels(trained, tmp_path):
+@pytest.mark.parametrize("mesh_shape", [(1, 1), (2, 1)])
+def test_eval_renders_attention_panels(trained, tmp_path, mesh_shape):
     """save_attention_maps: per-word attention figures land next to the
-    eval results and each result row carries normalized [len, N] maps."""
+    eval results and each result row carries normalized [len, N] maps —
+    on the plain path and through single-host mesh decoding."""
     config, state = trained
     config = config.replace(
         save_attention_maps=True,
+        mesh_shape=mesh_shape,
         eval_result_dir=str(tmp_path / "attn"),
         eval_result_file=str(tmp_path / "attn.json"),
     )
