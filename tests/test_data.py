@@ -195,3 +195,66 @@ class TestPrepare:
             assert batch["word_idxs"].shape == (cfg.batch_size, 20)
             seen += 1
         assert seen == ds.num_batches
+
+
+class TestDataSetProperties:
+    """Property-based invariants of the batch iterator (hypothesis)."""
+
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    @given(
+        n=st.integers(1, 64),
+        batch_size=st.integers(1, 16),
+        shuffle=st.booleans(),
+        seed=st.integers(0, 5),
+    )
+    @settings(max_examples=40, deadline=None)
+    def test_every_epoch_covers_every_item_exactly_once(
+        self, n, batch_size, shuffle, seed
+    ):
+        ds = DataSet(
+            list(range(n)), [f"f{i}" for i in range(n)], batch_size,
+            shuffle=shuffle, seed=seed,
+        )
+        for _ in range(2):
+            files = []
+            batches = 0
+            for batch in ds:
+                assert len(batch) == batch_size          # static shapes
+                files.extend(batch)
+                batches += 1
+            assert batches == ds.num_batches
+            # first `count` emitted items cover the dataset exactly once;
+            # the tail is fake_count padding drawn from real items
+            real = files[: n] if batch_size <= n else files[:n]
+            # reconstruct per-item order: non-pad portion is a permutation
+            emitted = files[: ds.count + ds.fake_count]
+            assert len(emitted) == ds.num_batches * batch_size
+            core = [f for b in range(ds.num_batches - 1)
+                    for f in files[b * batch_size:(b + 1) * batch_size]]
+            tail_real = files[(ds.num_batches - 1) * batch_size:][
+                : n - (ds.num_batches - 1) * batch_size
+            ]
+            assert sorted(core + tail_real) == sorted(f"f{i}" for i in range(n))
+
+    @given(
+        n=st.integers(2, 48),
+        batch_size=st.integers(1, 8),
+        epoch=st.integers(0, 3),
+        seed=st.integers(0, 3),
+    )
+    @settings(max_examples=40, deadline=None)
+    def test_seek_replays_any_epoch_tail(self, n, batch_size, epoch, seed):
+        mk = lambda: DataSet(  # noqa: E731
+            list(range(n)), [f"f{i}" for i in range(n)], batch_size,
+            shuffle=True, seed=seed,
+        )
+        ds = mk()
+        epochs = []
+        for _ in range(epoch + 1):
+            epochs.append([tuple(b) for b in ds])
+        offset = min(1, ds.num_batches - 1)
+        ds2 = mk()
+        ds2.seek(epoch, offset)
+        assert [tuple(b) for b in ds2] == epochs[epoch][offset:]
