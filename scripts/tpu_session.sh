@@ -25,6 +25,14 @@ for B in 32 64 128; do
   fi
 done
 
+echo "=== stage 1a2: joint CNN+RNN training throughput ==="
+BENCH_TRAIN_CNN=1 BENCH_WATCHDOG_S=480 timeout 500 python bench.py \
+  2>"$OUT/bench_joint.log" | tee "$OUT/bench_joint.json"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_joint.json" ]; then
+  echo "STAGE FAILED: bench joint (rc=$rc)"; FAILED="$FAILED bench_joint"
+fi
+
 echo "=== stage 1b: eval decode throughput (beam=3) ==="
 timeout 500 python scripts/bench_eval.py 2>"$OUT/bench_eval.log" \
   | tee "$OUT/bench_eval.json"
