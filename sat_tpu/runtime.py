@@ -99,6 +99,13 @@ def train(
     upgrade of the reference's async PS strategy (SURVEY.md §2.13)."""
     if dataset is None:
         dataset = prepare_train_data(config)
+    if dataset.count == 0:
+        raise ValueError(
+            "training dataset is empty after preparation — every caption was "
+            "filtered out (cap-length <= max_caption_length and vocab "
+            "filters, reference coco.py:323-361) or the caption file has no "
+            "annotations; check train_caption_file/max_caption_length"
+        )
     if state is None:
         state = setup_state(config, seed=seed)
 

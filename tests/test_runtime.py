@@ -460,3 +460,14 @@ def test_train_with_profiler_and_var_stats(coco_fixture, tmp_path):
     assert {r["step"] for r in stat_rows} == {3, 6}
     # attention stats ride along with normal metrics
     assert any("attention/mean" in r for r in rows)
+
+
+def test_empty_dataset_raises_clear_error(coco_fixture):
+    """All captions filtered out (max_caption_length below every fixture
+    caption) must fail with a diagnosis, not ZeroDivisionError deep in the
+    resume fast-forward."""
+    from sat_tpu import runtime
+
+    cfg = coco_fixture["config"].replace(max_caption_length=2)
+    with pytest.raises(ValueError, match="filtered out"):
+        runtime.train(cfg)
