@@ -119,7 +119,13 @@ class Config:
     mesh_axes: Tuple[str, ...] = ("data", "model")
     context_parallel: int = 1          # shard the context grid over 'model'
     prefetch_depth: int = 2            # host→HBM async pipeline depth
-    use_pallas_attention: bool = False # fused pallas soft-attention kernel
+    # Fused Pallas soft-attention kernel on the decode path (train and
+    # non-TPU backends always use the XLA path).  Measured on v5e at
+    # flagship decode shapes (B=48, N=196, da=D=512): ~400 µs vs
+    # 421-474 µs for XLA's fusion across runs (1.06-1.17x), and ~4 orders
+    # of magnitude lower context-vector error vs an fp32 ground truth
+    # (scripts/bench_pallas.py).
+    use_pallas_attention: bool = True
     num_data_workers: int = 8          # image-decode thread pool
     log_every: int = 10                # metric-writer cadence (steps)
     var_summary_period: int = 0        # per-variable stats cadence (0=off)
