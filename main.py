@@ -1,0 +1,12 @@
+"""Reference-surface entry point: ``python main.py --phase=train|eval|test``.
+
+The reference is driven as ``python main.py`` with the flags defined at
+/root/reference/main.py:15-36; this shim gives the identical invocation
+surface on top of the package CLI (``python -m sat_tpu.cli``), which also
+accepts ``--set key=value`` overrides for every Config field.
+"""
+
+from sat_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

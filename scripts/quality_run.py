@@ -163,6 +163,9 @@ def main() -> int:
         for line in f:
             rec = json.loads(line)
             if "total_loss" in rec:
+                if curve and rec["step"] <= curve[-1][0]:
+                    curve = []  # step reset: an earlier run into the same
+                    # --out dir appended here; keep only the final run
                 curve.append((rec["step"], rec["total_loss"]))
     sampled = curve[:: max(1, len(curve) // 12)]
     if curve and sampled[-1][0] != curve[-1][0]:
@@ -223,7 +226,7 @@ def main() -> int:
         lines.append(f"| {k} | {v:.4f} |")
     lines += [
         "",
-        f"Raw artifacts: `runs/quality/scores.json`, `runs/quality/results.json` "
+        f"Raw artifacts: `{args.out}/scores.json`, `{args.out}/results.json` "
         "(per-image captions).",
         "",
         "## Training loss curve (total_loss from metrics.jsonl)",
