@@ -462,12 +462,19 @@ def test_train_with_profiler_and_var_stats(coco_fixture, tmp_path):
     assert any("attention/mean" in r for r in rows)
 
 
-def test_empty_dataset_raises_clear_error(coco_fixture):
+def test_empty_dataset_raises_clear_error(coco_fixture, tmp_path):
     """All captions filtered out (max_caption_length below every fixture
     caption) must fail with a diagnosis, not ZeroDivisionError deep in the
-    resume fast-forward."""
+    resume fast-forward.  Own cache paths: the session fixture's
+    anns.csv/data.npy were tokenized under the default caption length and
+    would bypass the cap-length filter entirely."""
     from sat_tpu import runtime
 
-    cfg = coco_fixture["config"].replace(max_caption_length=2)
+    cfg = coco_fixture["config"].replace(
+        max_caption_length=2,
+        vocabulary_file=str(tmp_path / "vocab.csv"),
+        temp_annotation_file=str(tmp_path / "anns.csv"),
+        temp_data_file=str(tmp_path / "data.npy"),
+    )
     with pytest.raises(ValueError, match="filtered out"):
         runtime.train(cfg)
