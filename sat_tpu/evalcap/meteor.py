@@ -7,11 +7,12 @@ itself is not even shipped (.MISSING_LARGE_BLOBS).  This module implements
 METEOR 1.5 semantics (Denkowski & Lavie 2014, "Meteor Universal") directly
 in Python with a C++-accelerated twin (see native/):
 
-* stage-wise alignment with the 1.5 English matcher stages and weights —
-  exact 1.0, Porter-stem 0.6, synonym 0.8 — each stage pairing each
-  unmatched hypothesis word with its nearest unmatched reference
-  occurrence (a chunk-minimizing greedy stand-in for the jar's beam
-  aligner);
+* stage-wise alignment with the full 1.5 English matcher stages and
+  weights — exact 1.0, Porter-stem 0.6, synonym 0.8, paraphrase phrase
+  spans 0.6 — each word stage pairing each unmatched hypothesis word
+  with its nearest unmatched reference occurrence, and the paraphrase
+  stage aligning table phrase spans longest-first (a chunk-minimizing
+  greedy stand-in for the jar's beam aligner);
 * the 1.5 scoring with the English rank-tuned parameters α=0.85, β=0.2,
   γ=0.6, δ=0.75: content/function-word-discounted weighted precision and
   recall, Fmean = P·R/(α·P+(1−α)·R), fragmentation penalty
@@ -22,14 +23,13 @@ in Python with a C++-accelerated twin (see native/):
   behavior).
 
 Known divergences from the jar, quantified in tests/test_evalcap.py:
-* the paraphrase-table stage (weight 0.6) is omitted — the table is an
-  80MB external download the reference also never shipped; captions that
-  match only via multi-word paraphrases lose that fractional credit;
-* the synonym stage uses the compact bundled table in meteor_data.py
-  instead of full WordNet (unavailable offline), and the function-word
-  list is curated rather than frequency-derived — pairs outside those
-  tables fall back to exact/stem matching, biasing scores slightly LOW
-  relative to the jar, never high.
+* the synonym and paraphrase stages use the compact bundled tables in
+  meteor_data.py instead of full WordNet / the ~80MB pivoting-derived
+  paraphrase table (both unavailable offline; the reference never
+  shipped them either — its jar is a missing large blob), and the
+  function-word list is curated rather than frequency-derived — pairs
+  outside those tables fall back to exact/stem matching, biasing scores
+  slightly LOW relative to the jar, never high.
 """
 
 from __future__ import annotations
@@ -38,7 +38,12 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-from .meteor_data import FUNCTION_WORDS, build_synonym_index
+from .meteor_data import (
+    FUNCTION_WORDS,
+    MAX_PARAPHRASE_LEN,
+    build_paraphrase_index,
+    build_synonym_index,
+)
 
 # METEOR 1.5 English (rank-tuned) parameters — Denkowski & Lavie 2014,
 # Table 1 (the jar's `-l en` defaults, reference meteor.py:18-19).
@@ -50,9 +55,11 @@ DELTA = 0.75
 EXACT_WEIGHT = 1.0
 STEM_WEIGHT = 0.6
 SYNONYM_WEIGHT = 0.8
+PARAPHRASE_WEIGHT = 0.6
 
 _stemmer = None
 _syn_index: Optional[Dict[str, Set[int]]] = None
+_para_index: Optional[Dict[str, Set[int]]] = None
 
 
 def _stem(word: str) -> str:
@@ -79,14 +86,31 @@ def _synonyms() -> Dict[str, Set[int]]:
     return _syn_index
 
 
-def align(hyp: Sequence[str], ref: Sequence[str]) -> List[Tuple[int, int, float]]:
-    """Stage-wise greedy alignment returning (hyp_idx, ref_idx, weight).
+def _paraphrases() -> Dict[str, Set[int]]:
+    global _para_index
+    if _para_index is None:
+        _para_index = build_paraphrase_index()
+    return _para_index
+
+
+def align(
+    hyp: Sequence[str], ref: Sequence[str]
+) -> Tuple[List[Tuple[int, int, float]], Dict[int, float], Dict[int, float]]:
+    """Stage-wise greedy alignment.
+
+    Returns ``(pairs, hyp_matched, ref_matched)``: ``pairs`` are
+    (hyp_idx, ref_idx, weight) word pairings used for chunk counting;
+    the two dicts map matched word index → match weight per side (they
+    diverge from the pair list only for paraphrase span matches, whose
+    sides may cover different word counts).
 
     Within each stage, candidate pairs are matched in an order that favors
     monotone (chunk-minimizing) pairings: for each hypothesis word the
     nearest unmatched reference occurrence is taken.
     """
     matches: List[Tuple[int, int, float]] = []
+    hyp_matched: Dict[int, float] = {}
+    ref_matched: Dict[int, float] = {}
     hyp_used = [False] * len(hyp)
     ref_used = [False] * len(ref)
 
@@ -106,6 +130,8 @@ def align(hyp: Sequence[str], ref: Sequence[str]) -> List[Tuple[int, int, float]
             slots.remove(j)
             hyp_used[i], ref_used[j] = True, True
             matches.append((i, j, weight))
+            hyp_matched[i] = weight
+            ref_matched[j] = weight
 
     run_key_stage(lambda w: w, EXACT_WEIGHT)
     run_key_stage(_stem, STEM_WEIGHT)
@@ -129,8 +155,47 @@ def align(hyp: Sequence[str], ref: Sequence[str]) -> List[Tuple[int, int, float]
         if best_j >= 0:
             hyp_used[i], ref_used[best_j] = True, True
             matches.append((i, best_j, SYNONYM_WEIGHT))
+            hyp_matched[i] = SYNONYM_WEIGHT
+            ref_matched[best_j] = SYNONYM_WEIGHT
 
-    return sorted(matches)
+    # paraphrase stage (the jar's final match stage, weight 0.6): phrase
+    # spans from the table are aligned span-to-span.  Longest hypothesis
+    # span first (maximal matches), leftmost first within a length; the
+    # reference candidate is the nearest unmatched span sharing a group,
+    # longer spans preferred on distance ties.
+    para = _paraphrases()
+    for L in range(MAX_PARAPHRASE_LEN, 0, -1):
+        for i in range(0, len(hyp) - L + 1):
+            if any(hyp_used[i:i + L]):
+                continue
+            gids = para.get(" ".join(hyp[i:i + L]))
+            if not gids:
+                continue
+            best = None  # (distance, start, length)
+            for M in range(MAX_PARAPHRASE_LEN, 0, -1):
+                for j in range(0, len(ref) - M + 1):
+                    if any(ref_used[j:j + M]):
+                        continue
+                    rgids = para.get(" ".join(ref[j:j + M]))
+                    if rgids and (gids & rgids):
+                        d = abs(j - i)
+                        if best is None or d < best[0]:
+                            best = (d, j, M)
+            if best is None:
+                continue
+            _, j, M = best
+            for k in range(L):
+                hyp_used[i + k] = True
+                hyp_matched[i + k] = PARAPHRASE_WEIGHT
+            for k in range(M):
+                ref_used[j + k] = True
+                ref_matched[j + k] = PARAPHRASE_WEIGHT
+            # chunk accounting: the span pair is internally monotone, so
+            # it contributes one run of zipped word pairs
+            for k in range(min(L, M)):
+                matches.append((i + k, j + k, PARAPHRASE_WEIGHT))
+
+    return sorted(matches), hyp_matched, ref_matched
 
 
 def _chunks(matches: List[Tuple[int, int, float]]) -> int:
@@ -170,12 +235,16 @@ def _side_score(words: Sequence[str], matched: Dict[int, float]) -> float:
 
 def segment_stats(hypothesis: str, reference: str) -> Dict[str, float]:
     hyp, ref = hypothesis.split(), reference.split()
-    matches = align(hyp, ref)
+    pairs, hyp_matched, ref_matched = align(hyp, ref)
+    # m for the fragmentation penalty: average matched-word count over the
+    # two sides (METEOR 1.5; equals len(pairs) for word-level stages, and
+    # generalizes to paraphrase spans covering unequal word counts)
+    m = (len(hyp_matched) + len(ref_matched)) / 2.0
     return {
-        "matches": float(len(matches)),
-        "chunks": float(_chunks(matches)),
-        "p": _side_score(hyp, {i: w for i, _, w in matches}),
-        "r": _side_score(ref, {j: w for _, j, w in matches}),
+        "matches": m,
+        "chunks": float(_chunks(pairs)),
+        "p": _side_score(hyp, hyp_matched),
+        "r": _side_score(ref, ref_matched),
         "len_h": float(len(hyp)),
         "len_r": float(len(ref)),
     }

@@ -356,3 +356,95 @@ def build_synonym_index() -> Dict[str, Set[int]]:
         for w in group:
             index.setdefault(w, set()).add(gid)
     return index
+
+
+# ---------------------------------------------------------------------------
+# paraphrase groups (compact stand-in for METEOR 1.5's en paraphrase table)
+# ---------------------------------------------------------------------------
+# The jar's paraphrase stage (weight 0.6) matches multi-word phrase spans
+# via an ~80MB table extracted from bilingual pivoting; neither the table
+# nor egress to fetch it exists here.  This compact curated table keeps
+# the STAGE faithful (span-level alignment mechanics, weight, chunk
+# accounting) with coverage focused on English caption phrasing; phrases
+# within a group are mutually substitutable.  Divergence (table size) is
+# documented in meteor.py.
+
+_PARAPHRASE_GROUPS = [
+    ("next to", "beside", "alongside", "adjacent to", "close to", "near"),
+    ("in front of", "before", "ahead of"),
+    ("on top of", "atop", "upon", "on"),
+    ("a number of", "a group of", "a bunch of", "several", "many", "a lot of", "lots of"),
+    ("a couple of", "a pair of", "two"),
+    ("is sitting", "sits", "is seated"),
+    ("is standing", "stands"),
+    ("is riding", "rides"),
+    ("is holding", "holds", "is carrying", "carries"),
+    ("is wearing", "wears", "is dressed in", "dressed in"),
+    ("is eating", "eats", "is consuming"),
+    ("is walking", "walks", "is strolling"),
+    ("is running", "runs"),
+    ("is playing", "plays"),
+    ("is looking at", "looks at", "is watching", "watches", "is viewing"),
+    ("is flying", "flies", "is soaring"),
+    ("is jumping", "jumps", "is leaping"),
+    ("is lying", "lies", "is laying", "lays"),
+    ("gets ready to", "prepares to", "is about to", "is preparing to"),
+    ("in the middle of", "in the center of", "amid", "amidst"),
+    ("at the top of", "atop"),
+    ("at the bottom of", "below", "beneath", "under", "underneath"),
+    ("on the side of", "beside"),
+    ("a man", "a person", "a guy", "a gentleman", "someone"),
+    ("a woman", "a person", "a lady", "someone"),
+    ("a child", "a kid", "a youngster", "a little one"),
+    ("a large", "a big", "a huge"),
+    ("a small", "a little", "a tiny"),
+    ("a lot", "plenty", "a great deal"),
+    ("each other", "one another"),
+    ("in order to", "to", "so as to"),
+    ("because of", "due to", "owing to", "on account of"),
+    ("a few", "some", "a couple"),
+    ("right now", "currently", "at the moment", "presently"),
+    ("as well", "also", "too", "in addition"),
+    ("kind of", "sort of", "type of"),
+    ("is filled with", "is full of", "contains"),
+    ("is covered in", "is covered with"),
+    ("made of", "made from", "composed of", "constructed of"),
+    ("a photo of", "a picture of", "an image of", "a photograph of"),
+    ("black and white", "monochrome"),
+    ("fire hydrant", "hydrant"),
+    ("stop sign", "traffic sign"),
+    ("traffic light", "stoplight", "traffic signal"),
+    ("cell phone", "cellphone", "mobile phone", "phone"),
+    ("hot dog", "hotdog", "frankfurter"),
+    ("teddy bear", "stuffed bear", "stuffed animal"),
+    ("parking lot", "car park"),
+    ("train station", "railway station", "depot"),
+    ("living room", "lounge", "sitting room"),
+    ("dining table", "dinner table", "table"),
+    ("front of", "ahead of"),
+    ("group of people", "crowd", "crowd of people", "people"),
+    ("body of water", "water", "lake", "pond"),
+    ("up close", "close up", "closeup"),
+    ("gets on", "boards", "mounts"),
+    ("gets off", "dismounts", "exits"),
+    ("takes off", "departs", "lifts off"),
+    ("comes in", "enters", "arrives"),
+    ("goes out", "exits", "leaves"),
+]
+
+PARAPHRASE_GROUPS = tuple(_PARAPHRASE_GROUPS)
+
+MAX_PARAPHRASE_LEN = max(
+    len(p.split()) for g in PARAPHRASE_GROUPS for p in g
+)
+
+
+def build_paraphrase_index() -> Dict[str, Set[int]]:
+    """phrase (space-joined words) → set of group ids.  Two spans are
+    paraphrases iff their id sets intersect, mirroring the synonym
+    semantics at phrase granularity."""
+    index: Dict[str, Set[int]] = {}
+    for gid, group in enumerate(PARAPHRASE_GROUPS):
+        for p in group:
+            index.setdefault(p, set()).add(gid)
+    return index

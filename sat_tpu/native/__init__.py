@@ -32,7 +32,7 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_attempted = False
 
 
-ABI_VERSION = 2  # must match sat_native_abi_version() in api.cc
+ABI_VERSION = 3  # must match sat_native_abi_version() in api.cc
 
 
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -51,19 +51,28 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sat_free.restype = None
     lib.sat_free.argtypes = [ctypes.c_void_p]
     lib.sat_meteor_set_data.restype = None
-    lib.sat_meteor_set_data.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.sat_meteor_set_data.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+    ]
     _push_meteor_data(lib)
     return lib
 
 
 def _push_meteor_data(lib: ctypes.CDLL) -> None:
-    """Install the METEOR 1.5 function-word / synonym tables (single
-    source of truth: sat_tpu/evalcap/meteor_data.py)."""
-    from ..evalcap.meteor_data import FUNCTION_WORDS, SYNONYM_GROUPS
+    """Install the METEOR 1.5 function-word / synonym / paraphrase tables
+    (single source of truth: sat_tpu/evalcap/meteor_data.py)."""
+    from ..evalcap.meteor_data import (
+        FUNCTION_WORDS,
+        PARAPHRASE_GROUPS,
+        SYNONYM_GROUPS,
+    )
 
     lib.sat_meteor_set_data(
         " ".join(sorted(FUNCTION_WORDS)).encode("utf-8"),
         "\n".join(" ".join(g) for g in SYNONYM_GROUPS).encode("utf-8"),
+        "\n".join("|".join(g) for g in PARAPHRASE_GROUPS).encode("utf-8"),
     )
 
 

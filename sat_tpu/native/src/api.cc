@@ -12,7 +12,8 @@ std::vector<std::string> ptb_tokenize(const std::string&, bool);
 std::vector<std::string> ptb_tokenize_no_punct(const std::string&, bool);
 std::string porter_stem(const std::string&);
 double meteor_segment(const std::string&, const std::string&);
-void meteor_set_data(const std::string&, const std::string&);
+void meteor_set_data(const std::string&, const std::string&,
+                     const std::string&);
 }  // namespace sat_native
 
 namespace {
@@ -54,12 +55,15 @@ char* sat_stem(const char* word) {
 // Install the METEOR 1.5 language data (pushed from Python's
 // meteor_data.py so both backends share one source of truth):
 // function_words = space-joined words; synsets = newline-separated
-// groups of space-joined synonymous words.  Call before scoring; not
-// thread-safe against concurrent scoring (the ctypes layer holds a lock
-// during load).
-void sat_meteor_set_data(const char* function_words, const char* synsets) {
+// groups of space-joined synonymous words; paraphrases =
+// newline-separated groups of '|'-separated multi-word phrases.  Call
+// before scoring; not thread-safe against concurrent scoring (the
+// ctypes layer holds a lock during load).
+void sat_meteor_set_data(const char* function_words, const char* synsets,
+                         const char* paraphrases) {
   sat_native::meteor_set_data(function_words ? function_words : "",
-                              synsets ? synsets : "");
+                              synsets ? synsets : "",
+                              paraphrases ? paraphrases : "");
 }
 
 // METEOR score of one hypothesis against one reference, both given as
@@ -84,6 +88,6 @@ double sat_meteor_multi(const char* hyp, const char** refs, int n) {
 
 void sat_free(char* p) { std::free(p); }
 
-int sat_native_abi_version() { return 2; }
+int sat_native_abi_version() { return 3; }
 
 }  // extern "C"

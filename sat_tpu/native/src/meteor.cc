@@ -4,12 +4,14 @@
 //
 // Mirror of the Python implementation in sat_tpu/evalcap/meteor.py
 // (golden-tested against it): stage-wise greedy alignment — exact (1.0),
-// Porter-stem (0.6), synonym (0.8) with nearest-occurrence pairing — and
-// METEOR 1.5 scoring with the English rank-tuned parameters α=0.85,
-// β=0.2, γ=0.6, δ=0.75 (Denkowski & Lavie 2014): content/function-word
-// discounted P and R, fragmentation penalty only when the alignment has
-// more than one chunk.  The function-word and synonym tables are pushed
-// in from Python (meteor_data.py) via sat_meteor_set_data so both
+// Porter-stem (0.6), synonym (0.8) with nearest-occurrence pairing,
+// paraphrase phrase spans (0.6, longest-hyp-span-first) — and METEOR 1.5
+// scoring with the English rank-tuned parameters α=0.85, β=0.2, γ=0.6,
+// δ=0.75 (Denkowski & Lavie 2014): content/function-word discounted P
+// and R (per-side coverage, so paraphrase spans of unequal length score
+// correctly), fragmentation penalty only when the alignment has more
+// than one chunk.  The function-word, synonym, and paraphrase tables are
+// pushed in from Python (meteor_data.py) via sat_meteor_set_data so both
 // backends share one source of truth.
 
 #include <algorithm>
@@ -35,10 +37,14 @@ constexpr double kDelta = 0.75;
 constexpr double kExactWeight = 1.0;
 constexpr double kStemWeight = 0.6;
 constexpr double kSynonymWeight = 0.8;
+constexpr double kParaphraseWeight = 0.6;
 
 std::unordered_set<std::string> g_function_words;
 // word -> group ids (two words are synonyms iff their id sets intersect)
 std::unordered_map<std::string, std::vector<int>> g_synonyms;
+// phrase (space-joined) -> group ids; same intersection semantics
+std::unordered_map<std::string, std::vector<int>> g_paraphrases;
+int g_max_paraphrase_len = 0;
 
 std::vector<std::string> split_ws(const std::string& s) {
   std::vector<std::string> out;
@@ -61,7 +67,8 @@ struct Match {
 void run_key_stage(const std::vector<std::string>& hyp_keys,
                    const std::vector<std::string>& ref_keys,
                    std::vector<bool>* hyp_used, std::vector<bool>* ref_used,
-                   double weight, std::vector<Match>* matches) {
+                   double weight, std::vector<Match>* matches,
+                   std::vector<double>* hyp_w, std::vector<double>* ref_w) {
   std::map<std::string, std::vector<int>> ref_slots;
   for (int j = 0; j < static_cast<int>(ref_keys.size()); j++) {
     if (!(*ref_used)[j]) ref_slots[ref_keys[j]].push_back(j);
@@ -80,6 +87,8 @@ void run_key_stage(const std::vector<std::string>& hyp_keys,
     (*hyp_used)[i] = true;
     (*ref_used)[j] = true;
     matches->push_back({i, j, weight});
+    (*hyp_w)[i] = weight;
+    (*ref_w)[j] = weight;
   }
 }
 
@@ -94,7 +103,9 @@ void run_synonym_stage(const std::vector<std::string>& hyp,
                        const std::vector<std::string>& ref,
                        std::vector<bool>* hyp_used,
                        std::vector<bool>* ref_used,
-                       std::vector<Match>* matches) {
+                       std::vector<Match>* matches,
+                       std::vector<double>* hyp_w,
+                       std::vector<double>* ref_w) {
   for (int i = 0; i < static_cast<int>(hyp.size()); i++) {
     if ((*hyp_used)[i]) continue;
     auto hit = g_synonyms.find(hyp[i]);
@@ -112,14 +123,79 @@ void run_synonym_stage(const std::vector<std::string>& hyp,
       (*hyp_used)[i] = true;
       (*ref_used)[best_j] = true;
       matches->push_back({i, best_j, kSynonymWeight});
+      (*hyp_w)[i] = kSynonymWeight;
+      (*ref_w)[best_j] = kSynonymWeight;
     }
   }
 }
 
-// δ-discounted weighted match fraction for one side (P or R).
-// side_idx: 0 = use hyp_idx, 1 = use ref_idx.
+std::string join_span(const std::vector<std::string>& words, int start,
+                      int len) {
+  std::string out;
+  for (int k = 0; k < len; k++) {
+    if (k) out += ' ';
+    out += words[start + k];
+  }
+  return out;
+}
+
+// Paraphrase stage: longest unmatched hypothesis span first (leftmost
+// within a length); reference candidate = nearest unmatched span sharing
+// a group id, longer spans preferred on distance ties (mirrors the
+// Python iteration order exactly).  Covered words get per-side weight;
+// zipped word pairs feed the chunk count.
+void run_paraphrase_stage(const std::vector<std::string>& hyp,
+                          const std::vector<std::string>& ref,
+                          std::vector<bool>* hyp_used,
+                          std::vector<bool>* ref_used,
+                          std::vector<Match>* matches,
+                          std::vector<double>* hyp_w,
+                          std::vector<double>* ref_w) {
+  auto span_free = [](const std::vector<bool>& used, int start, int len) {
+    for (int k = 0; k < len; k++)
+      if (used[start + k]) return false;
+    return true;
+  };
+  for (int L = g_max_paraphrase_len; L >= 1; L--) {
+    for (int i = 0; i + L <= static_cast<int>(hyp.size()); i++) {
+      if (!span_free(*hyp_used, i, L)) continue;
+      auto hit = g_paraphrases.find(join_span(hyp, i, L));
+      if (hit == g_paraphrases.end()) continue;
+      int best_j = -1, best_m = 0, best_d = 0;
+      for (int M = g_max_paraphrase_len; M >= 1; M--) {
+        for (int j = 0; j + M <= static_cast<int>(ref.size()); j++) {
+          if (!span_free(*ref_used, j, M)) continue;
+          auto rit = g_paraphrases.find(join_span(ref, j, M));
+          if (rit == g_paraphrases.end()) continue;
+          if (!share_group(hit->second, rit->second)) continue;
+          int d = std::abs(j - i);
+          if (best_j < 0 || d < best_d) {
+            best_j = j;
+            best_m = M;
+            best_d = d;
+          }
+        }
+      }
+      if (best_j < 0) continue;
+      for (int k = 0; k < L; k++) {
+        (*hyp_used)[i + k] = true;
+        (*hyp_w)[i + k] = kParaphraseWeight;
+      }
+      for (int k = 0; k < best_m; k++) {
+        (*ref_used)[best_j + k] = true;
+        (*ref_w)[best_j + k] = kParaphraseWeight;
+      }
+      for (int k = 0; k < std::min(L, best_m); k++) {
+        matches->push_back({i + k, best_j + k, kParaphraseWeight});
+      }
+    }
+  }
+}
+
+// δ-discounted weighted match fraction for one side (P or R) from the
+// per-side coverage weights (-1 = unmatched).
 double side_score(const std::vector<std::string>& words,
-                  const std::vector<Match>& matches, int side_idx) {
+                  const std::vector<double>& weights) {
   int n_f = 0;
   for (const auto& w : words)
     if (g_function_words.count(w)) n_f++;
@@ -127,12 +203,12 @@ double side_score(const std::vector<std::string>& words,
   double denom = kDelta * n_c + (1.0 - kDelta) * n_f;
   if (denom == 0.0) return 0.0;
   double wc = 0.0, wf = 0.0;
-  for (const auto& m : matches) {
-    int idx = side_idx == 0 ? m.hyp_idx : m.ref_idx;
+  for (size_t idx = 0; idx < words.size(); idx++) {
+    if (weights[idx] < 0.0) continue;
     if (g_function_words.count(words[idx]))
-      wf += m.weight;
+      wf += weights[idx];
     else
-      wc += m.weight;
+      wc += weights[idx];
   }
   return (kDelta * wc + (1.0 - kDelta) * wf) / denom;
 }
@@ -140,7 +216,8 @@ double side_score(const std::vector<std::string>& words,
 }  // namespace
 
 void meteor_set_data(const std::string& function_words,
-                     const std::string& synset_lines) {
+                     const std::string& synset_lines,
+                     const std::string& paraphrase_lines) {
   g_function_words.clear();
   for (const auto& w : split_ws(function_words)) g_function_words.insert(w);
   g_synonyms.clear();
@@ -153,6 +230,30 @@ void meteor_set_data(const std::string& function_words,
     for (const auto& w : words) g_synonyms[w].push_back(gid);
     gid++;
   }
+  // paraphrase groups: one group per line, phrases separated by '|'
+  g_paraphrases.clear();
+  g_max_paraphrase_len = 0;
+  std::istringstream pin(paraphrase_lines);
+  int pgid = 0;
+  while (std::getline(pin, line)) {
+    bool any = false;
+    size_t pos = 0;
+    while (pos <= line.size()) {
+      size_t bar = line.find('|', pos);
+      if (bar == std::string::npos) bar = line.size();
+      std::string phrase = line.substr(pos, bar - pos);
+      auto words = split_ws(phrase);
+      if (!words.empty()) {
+        g_paraphrases[join_span(words, 0, static_cast<int>(words.size()))]
+            .push_back(pgid);
+        g_max_paraphrase_len =
+            std::max(g_max_paraphrase_len, static_cast<int>(words.size()));
+        any = true;
+      }
+      pos = bar + 1;
+    }
+    if (any) pgid++;
+  }
 }
 
 double meteor_segment(const std::string& hypothesis,
@@ -162,16 +263,20 @@ double meteor_segment(const std::string& hypothesis,
   if (hyp.empty() || ref.empty()) return 0.0;
 
   std::vector<bool> hyp_used(hyp.size(), false), ref_used(ref.size(), false);
+  std::vector<double> hyp_w(hyp.size(), -1.0), ref_w(ref.size(), -1.0);
   std::vector<Match> matches;
-  run_key_stage(hyp, ref, &hyp_used, &ref_used, kExactWeight, &matches);
+  run_key_stage(hyp, ref, &hyp_used, &ref_used, kExactWeight, &matches,
+                &hyp_w, &ref_w);
 
   std::vector<std::string> hyp_stems(hyp.size()), ref_stems(ref.size());
   for (size_t i = 0; i < hyp.size(); i++) hyp_stems[i] = porter_stem(hyp[i]);
   for (size_t j = 0; j < ref.size(); j++) ref_stems[j] = porter_stem(ref[j]);
   run_key_stage(hyp_stems, ref_stems, &hyp_used, &ref_used, kStemWeight,
-                &matches);
+                &matches, &hyp_w, &ref_w);
 
-  run_synonym_stage(hyp, ref, &hyp_used, &ref_used, &matches);
+  run_synonym_stage(hyp, ref, &hyp_used, &ref_used, &matches, &hyp_w, &ref_w);
+  run_paraphrase_stage(hyp, ref, &hyp_used, &ref_used, &matches, &hyp_w,
+                       &ref_w);
 
   if (matches.empty()) return 0.0;
   std::sort(matches.begin(), matches.end(),
@@ -188,14 +293,22 @@ double meteor_segment(const std::string& hypothesis,
     }
   }
 
-  double p = side_score(hyp, matches, 0);
-  double r = side_score(ref, matches, 1);
+  // m for the fragmentation penalty: average matched-word count over the
+  // two sides (equals the pair count for word-level stages; generalizes
+  // to paraphrase spans of unequal length)
+  int hyp_covered = 0, ref_covered = 0;
+  for (double w : hyp_w) hyp_covered += (w >= 0.0);
+  for (double w : ref_w) ref_covered += (w >= 0.0);
+  double m_avg = 0.5 * (hyp_covered + ref_covered);
+
+  double p = side_score(hyp, hyp_w);
+  double r = side_score(ref, ref_w);
   if (p == 0.0 || r == 0.0) return 0.0;
   double fmean = (p * r) / (kAlpha * p + (1.0 - kAlpha) * r);
   // single-chunk alignments carry no fragmentation penalty (jar
   // behavior: identical sentences score exactly 1.0)
   if (chunks <= 1) return fmean;
-  double frag = static_cast<double>(chunks) / matches.size();
+  double frag = static_cast<double>(chunks) / m_avg;
   double penalty = kGamma * std::pow(frag, kBeta);
   return fmean * (1.0 - penalty);
 }

@@ -178,3 +178,65 @@ class TestOrchestrator:
         assert out["Bleu_1"] > 0.99
         assert out["ROUGE_L"] > 0.9
         assert len(scorer.img_to_eval) == 5
+
+
+class TestMeteorParaphrase:
+    """Paraphrase phrase-span stage (METEOR 1.5's final match stage,
+    weight 0.6, compact bundled table)."""
+
+    def test_paraphrase_earns_credit(self):
+        from sat_tpu.evalcap.meteor import Meteor
+
+        gts = {1: ["a dog sleeping next to a fence"]}
+        para = {1: ["a dog sleeping beside a fence"]}      # next to ~ beside
+        none = {1: ["a dog sleeping qwerty a fence"]}
+        s_para, _ = Meteor().compute_score(gts, para)
+        s_none, _ = Meteor().compute_score(gts, none)
+        assert s_para > s_none
+
+    def test_unequal_span_sides_cover_all_words(self):
+        # 'in front of' (3 words) ~ 'before' (1 word): hypothesis covers 1
+        # matched word, reference covers 3 — P and R use per-side coverage
+        from sat_tpu.evalcap.meteor import align
+
+        hyp = "the dog stood before the door".split()
+        ref = "the dog stood in front of the door".split()
+        pairs, hyp_m, ref_m = align(hyp, ref)
+        assert hyp_m[3] == 0.6                       # 'before'
+        assert ref_m[3] == ref_m[4] == ref_m[5] == 0.6   # 'in front of'
+
+    def test_longest_span_matched_first(self):
+        # 'on top of' must match as one 3-word phrase (group with 'atop'),
+        # not leave 'on' to pair elsewhere
+        from sat_tpu.evalcap.meteor import align
+
+        hyp = "a cat on top of a car".split()
+        ref = "a cat atop a car".split()
+        pairs, hyp_m, ref_m = align(hyp, ref)
+        assert hyp_m[2] == hyp_m[3] == hyp_m[4] == 0.6
+        assert ref_m[2] == 0.6
+
+    def test_exact_sentence_still_scores_one(self):
+        from sat_tpu.evalcap.meteor import Meteor
+
+        gts = {1: ["a man is riding a horse next to the beach"]}
+        score, _ = Meteor().compute_score(gts, {1: gts[1][:]})
+        assert score == pytest.approx(1.0)
+
+    def test_native_agrees_on_paraphrase_sentences(self):
+        from sat_tpu import native
+        from sat_tpu.evalcap import meteor as py_meteor
+
+        if not native.available():
+            pytest.skip("native library not built")
+        cases = [
+            ("a dog sleeping beside a fence", "a dog sleeping next to a fence"),
+            ("the dog stood before the door", "the dog stood in front of the door"),
+            ("a cat atop a car", "a cat on top of a car"),
+            ("a man rides a horse", "a man is riding a horse"),
+            ("several people near a bus", "a group of people next to a bus"),
+        ]
+        for hyp, ref in cases:
+            want = py_meteor.score_from_stats(py_meteor.segment_stats(hyp, ref))
+            got = native.meteor_segment(hyp, ref)
+            assert got == pytest.approx(want, abs=1e-12), (hyp, ref)
