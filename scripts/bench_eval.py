@@ -62,21 +62,27 @@ def main() -> int:
     @jax.jit
     def decode(variables, images):
         contexts, _ = encode(variables, config, images, train=False)
-        return beam_search_jit(
+        out = beam_search_jit(
             variables["params"]["decoder"], config, contexts, eos,
             beam_size=args.beam,
         )
+        # serializing dependency for chained timing: a score-derived term
+        # too small to perturb fp32 image pixels (block_until_ready on
+        # independent dispatches is not trustworthy on the tunneled
+        # platform — see PERF.md methodology note)
+        chained = images + 1e-30 * out.log_scores.sum()
+        return out, chained
 
     t0 = time.perf_counter()
-    out = decode(variables, images)
-    jax.block_until_ready(out)
+    out, images_c = decode(variables, images)
+    jax.device_get(out.log_scores[0, 0])
     compile_s = time.perf_counter() - t0
     print(f"compile+first: {compile_s:.1f}s", file=sys.stderr, flush=True)
 
     t0 = time.perf_counter()
     for _ in range(args.iters):
-        out = decode(variables, images)
-    jax.block_until_ready(out)
+        out, images_c = decode(variables, images_c)
+    jax.device_get(out.log_scores[0, 0])
     elapsed = time.perf_counter() - t0
 
     images_per_sec = args.iters * B / elapsed
