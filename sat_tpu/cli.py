@@ -110,6 +110,36 @@ def build_config(argv: Optional[List[str]] = None):
     return config, cli
 
 
+def _arm_device_watchdog() -> "callable":
+    """Warn (don't abort) when device initialization stalls.
+
+    A wedged TPU tunnel makes jax.devices() block uninterruptibly with no
+    output (observed repeatedly in this environment); without a hint the
+    CLI looks hung for no reason.  SAT_DEVICE_WATCHDOG_S tunes the delay
+    (default 180s, 0 disables).  Returns a disarm callback."""
+    import os
+    import threading
+
+    delay = float(os.environ.get("SAT_DEVICE_WATCHDOG_S", "180"))
+    done = threading.Event()
+    if delay <= 0:
+        return done.set
+
+    def monitor():
+        if not done.wait(delay):
+            print(
+                f"sat_tpu: device initialization has taken >{delay:.0f}s — "
+                "the TPU backend may be unreachable. For a CPU run, set "
+                "JAX_PLATFORMS=cpu; to silence this, set "
+                "SAT_DEVICE_WATCHDOG_S=0.",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    threading.Thread(target=monitor, daemon=True).start()
+    return done.set
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     config, cli = build_config(argv)
 
@@ -118,6 +148,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .parallel import initialize_distributed
 
     initialize_distributed()
+
+    disarm = _arm_device_watchdog()
+    import jax
+
+    jax.devices()  # force backend init under the watchdog
+    disarm()
 
     from . import runtime
 
