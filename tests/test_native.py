@@ -134,3 +134,27 @@ def test_lower_false_routes_to_python():
 
     text = "Don't stop Cannot."
     assert tokenize(text, lower=False) == tokenize_pure(text, lower=False)
+
+
+def test_meteor_fuzz_matches_python():
+    """Randomized agreement sweep: word soups drawn from a vocabulary that
+    triggers every stage (exact, stem variants, synonyms, multi-word
+    paraphrase spans) must score bitwise-identically in both backends."""
+    import numpy as np
+
+    if not native.available():
+        pytest.skip("native library not built")
+    vocab = (
+        "a the dog dogs cat cats man woman person people runs running ran "
+        "sits sitting stands standing next to beside in front of before "
+        "atop on top of near big large small little horse pony street road "
+        "garden yard quickly quick is was are and with under over".split()
+    )
+    rng = np.random.default_rng(1234)
+    for _ in range(200):
+        n_h, n_r = rng.integers(1, 14, size=2)
+        hyp = " ".join(rng.choice(vocab, size=n_h))
+        ref = " ".join(rng.choice(vocab, size=n_r))
+        want = py_meteor.score_from_stats(py_meteor.segment_stats(hyp, ref))
+        got = native.meteor_segment(hyp, ref)
+        assert got == pytest.approx(want, abs=1e-12), (hyp, ref)
