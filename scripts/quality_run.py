@@ -91,6 +91,15 @@ def main() -> int:
         "--cpu", action="store_true",
         help="pin the CPU backend (the env force-registers the TPU plugin)",
     )
+    ap.add_argument(
+        "--cnn", default="vgg16", choices=["vgg16", "resnet50"],
+        help="encoder family (resnet50 exercises the BN/bottleneck path)",
+    )
+    ap.add_argument(
+        "--no-results-md", action="store_true",
+        help="write scores.json only; leave RESULTS.md untouched (for "
+        "secondary-evidence runs, e.g. the resnet50 variant)",
+    )
     args = ap.parse_args()
 
     if args.cpu:
@@ -137,6 +146,7 @@ def main() -> int:
         "save_period=0",
         "log_every=10",
         f"image_size={args.image_size}",
+        f"cnn={args.cnn}",
     ]
     set_args = [x for o in overrides for x in ("--set", o)]
 
@@ -145,6 +155,18 @@ def main() -> int:
     import jax
 
     from sat_tpu import runtime
+
+    # Persistent compilation cache (same dir as bench.py): the resnet50
+    # CPU-XLA compile in particular runs tens of minutes cold on this
+    # 1-core host; a rerun must not pay it twice.
+    try:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(repo, ".jax_compile_cache")
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:
+        print(f"[quality] compilation cache not enabled: {e!r}")
 
     device = jax.devices()[0]
     print(f"[quality +{time.time()-t0:5.1f}s] device: {device.device_kind} ({device.platform})")
@@ -243,16 +265,20 @@ def main() -> int:
         f"`--train_cnn`, `batch_size={args.batch_size}`, `vocabulary_size=200`, "
         "`fc_drop_rate=0.1`, `lstm_drop_rate=0.1`, `initial_learning_rate=3e-4` "
         f"(overfit protocol), `num_epochs={num_epochs}`, "
-        f"`image_size={args.image_size}`. Everything else — VGG16 encoder, "
-        "512-unit attention LSTM, Adam, global-norm clip 5.0, "
+        f"`image_size={args.image_size}`. Everything else — {args.cnn} "
+        "encoder, 512-unit attention LSTM, Adam, global-norm clip 5.0, "
         "doubly-stochastic attention penalty — is the reference-published "
         "configuration (`/root/reference/config.py:8-43`).",
         "",
     ]
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    with open(os.path.join(repo_root, "RESULTS.md"), "w") as f:
-        f.write("\n".join(lines))
-    print(f"[quality +{time.time()-t0:5.1f}s] RESULTS.md written")
+    if args.no_results_md:
+        print(f"[quality +{time.time()-t0:5.1f}s] scores.json written "
+              "(--no-results-md)")
+    else:
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(repo_root, "RESULTS.md"), "w") as f:
+            f.write("\n".join(lines))
+        print(f"[quality +{time.time()-t0:5.1f}s] RESULTS.md written")
     for k, v in scores.items():
         print(f"  {k}: {v:.4f}")
     return 0
