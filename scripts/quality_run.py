@@ -77,6 +77,25 @@ def make_corpus(root: str, num_images: int = 48, image_edge: int = 96):
     return img_dir, caption_file
 
 
+def read_loss_curve(metrics_path: str, samples: int = 12):
+    """(step, total_loss) rows of the FINAL run in a metrics.jsonl,
+    downsampled to ~``samples`` rows (last row always kept).  A step that
+    does not increase marks the start of a newer run appended to the same
+    --out dir; earlier segments are discarded."""
+    curve = []
+    with open(metrics_path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if "total_loss" in rec:
+                if curve and rec["step"] <= curve[-1][0]:
+                    curve = []
+                curve.append((rec["step"], rec["total_loss"]))
+    sampled = curve[:: max(1, len(curve) // samples)]
+    if curve and sampled[-1][0] != curve[-1][0]:
+        sampled.append(curve[-1])
+    return sampled
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=600, help="target train steps")
@@ -179,19 +198,7 @@ def main() -> int:
     scores = runtime.evaluate(eval_config, state=state)
     total_s = time.time() - t0
 
-    # ---- loss curve from metrics.jsonl ----
-    curve = []
-    with open(os.path.join(root, "summary", "metrics.jsonl")) as f:
-        for line in f:
-            rec = json.loads(line)
-            if "total_loss" in rec:
-                if curve and rec["step"] <= curve[-1][0]:
-                    curve = []  # step reset: an earlier run into the same
-                    # --out dir appended here; keep only the final run
-                curve.append((rec["step"], rec["total_loss"]))
-    sampled = curve[:: max(1, len(curve) // 12)]
-    if curve and sampled[-1][0] != curve[-1][0]:
-        sampled.append(curve[-1])
+    sampled = read_loss_curve(os.path.join(root, "summary", "metrics.jsonl"))
 
     with open(os.path.join(root, "scores.json"), "w") as f:
         json.dump(

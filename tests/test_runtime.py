@@ -478,3 +478,23 @@ def test_empty_dataset_raises_clear_error(coco_fixture, tmp_path):
     )
     with pytest.raises(ValueError, match="filtered out"):
         runtime.train(cfg)
+
+
+def test_quality_run_loss_curve_keeps_final_segment(tmp_path):
+    """The committed-evidence loss curve must come from the FINAL run when
+    an earlier run appended to the same metrics.jsonl (step reset marks
+    the boundary)."""
+    import json as _json
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    from quality_run import read_loss_curve
+
+    p = tmp_path / "metrics.jsonl"
+    rows = [{"step": s, "total_loss": 3.0} for s in (10, 140, 400)]
+    rows += [{"step": s, "total_loss": 2.0} for s in range(10, 1210, 10)]
+    p.write_text("".join(_json.dumps(r) + "\n" for r in rows))
+    steps = [s for s, _ in read_loss_curve(str(p))]
+    assert steps[-1] == 1200
+    assert all(b > a for a, b in zip(steps, steps[1:]))
+    assert all(loss == 2.0 for _, loss in read_loss_curve(str(p)))
