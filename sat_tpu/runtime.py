@@ -456,41 +456,65 @@ def _render_attention_panel(
     """Per-word attention figure (Xu et al. fig. 5): the image, then one
     tile per generated word with its soft-attention map α upsampled from
     the context grid and overlaid.  alphas: [len(words), N], N a square
-    grid (196 → 14×14 for VGG16, 49 → 7×7 for ResNet50)."""
+    grid (196 → 14×14 for VGG16, 49 → 7×7 for ResNet50).
+
+    Composited directly with cv2 (colormap + blend + grid + putText)
+    rather than matplotlib: measured ~20x faster per panel on this host
+    (matplotlib's tight_layout alone dominated), which matters because
+    eval renders one panel per image."""
     import cv2
-    import matplotlib
 
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
-
-    img = plt.imread(image_file)
-    h, w = img.shape[:2]
+    bgr = cv2.imread(image_file, cv2.IMREAD_COLOR)
+    if bgr is None:
+        raise FileNotFoundError(image_file)
+    h, w = bgr.shape[:2]
+    tile_w = max(180, min(w, 360))
+    tile_h = int(round(tile_w * h / w))
+    base = cv2.resize(bgr, (tile_w, tile_h), interpolation=cv2.INTER_AREA)
     g = int(round(np.sqrt(alphas.shape[1])))
     # one shared color scale across the caption: per-tile autoscaling
     # would stretch a near-uniform map to the same contrast as a sharply
     # peaked one, faking localization
     vmax = float(alphas.max()) or 1.0
-    n = len(words) + 1
-    cols = min(5, n)
-    rows = -(-n // cols)
-    fig, axes = plt.subplots(rows, cols, figsize=(2.2 * cols, 2.4 * rows))
-    axes = np.atleast_1d(axes).ravel()
-    axes[0].imshow(img)
-    axes[0].set_title("input", fontsize=8)
+
+    label_h = 22
+    pad = 6
+
+    def tile(image, label):
+        canvas = np.full(
+            (label_h + tile_h, tile_w, 3), 255, dtype=np.uint8
+        )
+        cv2.putText(
+            canvas, label[:24], (4, label_h - 7),
+            cv2.FONT_HERSHEY_SIMPLEX, 0.45, (0, 0, 0), 1, cv2.LINE_AA,
+        )
+        canvas[label_h:, :, :] = image
+        return canvas
+
+    tiles = [tile(base, "input")]
     for t, word in enumerate(words):
-        ax = axes[t + 1]
         amap = cv2.resize(
-            alphas[t].reshape(g, g).astype(np.float32), (w, h),
+            alphas[t].reshape(g, g).astype(np.float32), (tile_w, tile_h),
             interpolation=cv2.INTER_CUBIC,
         )
-        ax.imshow(img)
-        ax.imshow(amap, alpha=0.6, cmap="jet", vmin=0.0, vmax=vmax)
-        ax.set_title(word, fontsize=8)
-    for ax in axes:
-        ax.axis("off")
-    fig.tight_layout()
-    fig.savefig(out_file, dpi=110)
-    plt.close(fig)
+        amap_u8 = np.clip(amap / vmax * 255.0, 0.0, 255.0).astype(np.uint8)
+        heat = cv2.applyColorMap(amap_u8, cv2.COLORMAP_JET)
+        blend = cv2.addWeighted(base, 0.4, heat, 0.6, 0.0)
+        tiles.append(tile(blend, word))
+
+    cols = min(5, len(tiles))
+    rows = -(-len(tiles) // cols)
+    cell_h, cell_w = tiles[0].shape[:2]
+    panel = np.full(
+        (rows * (cell_h + pad) + pad, cols * (cell_w + pad) + pad, 3),
+        255, dtype=np.uint8,
+    )
+    for idx, t_img in enumerate(tiles):
+        r, c = divmod(idx, cols)
+        y = pad + r * (cell_h + pad)
+        x = pad + c * (cell_w + pad)
+        panel[y:y + cell_h, x:x + cell_w] = t_img
+    cv2.imwrite(out_file, panel)
 
 
 def _save_attention_panels(results: List[Dict[str, Any]], out_dir: str) -> None:
