@@ -530,19 +530,43 @@ def _save_attention_panels(results: List[Dict[str, Any]], out_dir: str) -> None:
 
 
 def _render_caption_image(image_file: str, caption: str, out_file: str) -> None:
-    """Captioned-JPG artifact (reference base_model.py:96-107)."""
-    import matplotlib
+    """Captioned-JPG artifact (reference base_model.py:96-107), composited
+    with cv2 (caption banner above the image) — same ~100x-per-artifact
+    speedup story as _render_attention_panel."""
+    import cv2
 
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
+    img = cv2.imread(image_file, cv2.IMREAD_COLOR)
+    if img is None:
+        raise FileNotFoundError(image_file)
+    h, w = img.shape[:2]
+    out_w = max(320, min(w, 640))
+    out_h = int(round(out_w * h / w))
+    img = cv2.resize(img, (out_w, out_h), interpolation=cv2.INTER_AREA)
 
-    img = plt.imread(image_file)
-    fig = plt.figure()
-    plt.imshow(img)
-    plt.axis("off")
-    plt.title(caption)
-    fig.savefig(out_file)
-    plt.close(fig)
+    # wrap the caption into lines that fit the banner
+    font, scale, thick = cv2.FONT_HERSHEY_SIMPLEX, 0.5, 1
+    words = caption.split()
+    lines, cur = [], ""
+    for word in words:
+        cand = (cur + " " + word).strip()
+        if cv2.getTextSize(cand, font, scale, thick)[0][0] > out_w - 12 and cur:
+            lines.append(cur)
+            cur = word
+        else:
+            cur = cand
+    if cur:
+        lines.append(cur)
+
+    line_h = 20
+    banner_h = 8 + line_h * max(1, len(lines))
+    canvas = np.full((banner_h + out_h, out_w, 3), 255, dtype=np.uint8)
+    for k, line in enumerate(lines):
+        cv2.putText(
+            canvas, line, (6, 8 + line_h * k + 12),
+            font, scale, (0, 0, 0), thick, cv2.LINE_AA,
+        )
+    canvas[banner_h:, :, :] = img
+    cv2.imwrite(out_file, canvas)
 
 
 # ---------------------------------------------------------------------------
