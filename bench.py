@@ -140,6 +140,8 @@ def main() -> None:
     config = Config(batch_size=B, train_cnn=train_cnn)
     if "BENCH_RNG_IMPL" in os.environ:  # e.g. threefry2x32, to rerun the
         config = config.replace(rng_impl=os.environ["BENCH_RNG_IMPL"])  # PERF.md A/B
+    if os.environ.get("BENCH_REMAT") == "1":  # decoder-remat A/B
+        config = config.replace(remat_decoder=True)
 
     T = config.max_caption_length
 

@@ -115,6 +115,11 @@ class Config:
     # key-derivation quality for speed on top of rbg.  Param init always
     # uses threefry so initial weights never depend on this knob.
     rng_impl: str = "rbg"
+    # Rematerialize the decoder scan step in the backward pass (keep
+    # matmul outputs, regenerate dropout masks/elementwise from the
+    # per-step keys instead of stacking T steps of residuals).
+    # Numerically identical; off by default pending a measured win.
+    remat_decoder: bool = False
     mesh_shape: Tuple[int, ...] = (1, 1)   # (data, model) device mesh
     mesh_axes: Tuple[str, ...] = ("data", "model")
     context_parallel: int = 1          # shard the context grid over 'model'

@@ -410,6 +410,19 @@ def teacher_forced_decode(
         )
         return state, (logits, alpha)
 
+    if train and config.remat_decoder:
+        # Rematerialize the step in backward: keep matmul outputs,
+        # regenerate dropout masks / elementwise chains from rng_t instead
+        # of stacking them as residuals across T steps.  Numerically
+        # identical (same keys -> same masks); trades recompute for HBM
+        # residual traffic.  prevent_cse off: scan bodies are not subject
+        # to the CSE hazard checkpoint guards against.
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_saveable,
+            prevent_cse=False,
+        )
+
     _, (logits, alphas) = jax.lax.scan(
         body, state, (words_in.T, step_rngs)
     )

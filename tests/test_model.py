@@ -276,3 +276,31 @@ class TestRngImpl:
     def test_invalid_impl_rejected(self):
         with pytest.raises(ValueError, match="rng_impl"):
             tiny_config(rng_impl="philox")
+
+
+class TestRematDecoder:
+    def test_remat_grads_match_baseline(self):
+        """config.remat_decoder recomputes the scan step in backward from
+        the same per-step keys — loss and grads must match the
+        residual-stacking baseline to float tolerance."""
+        base = tiny_config(fc_drop_rate=0.3, lstm_drop_rate=0.2)
+        remat = base.replace(remat_decoder=True)
+        batch = tiny_contexts_batch(base)
+        variables = init_variables(jax.random.PRNGKey(0), base)
+        key = jax.random.key(5, impl=base.rng_impl)
+
+        def loss_fn(cfg):
+            def f(v):
+                total, _ = compute_loss(v, cfg, batch, rng=key, train=True)
+                return total
+            return jax.jit(jax.value_and_grad(f))
+
+        l0, g0 = loss_fn(base)(variables)
+        l1, g1 = loss_fn(remat)(variables)
+        assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+            ),
+            g0, g1,
+        )
