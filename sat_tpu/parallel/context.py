@@ -188,6 +188,16 @@ def _cp_loss_body(
         )
         return state, (logits, alpha_local)
 
+    if train and config.remat_decoder:
+        # same remat story as teacher_forced_decode: regenerate dropout
+        # masks/elementwise from rng_t in backward instead of stacking
+        # residuals; the psum collectives sit on the dot path and stay saved
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_saveable,
+            prevent_cse=False,
+        )
+
     _, (logits, alphas_local) = jax.lax.scan(body, state, (words_in.T, step_rngs))
     logits = logits.transpose(1, 0, 2)           # [B, T, V]
     alphas_local = alphas_local.transpose(1, 0, 2)  # [B, T, Nl]
