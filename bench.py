@@ -142,6 +142,8 @@ def main() -> None:
         config = config.replace(rng_impl=os.environ["BENCH_RNG_IMPL"])  # PERF.md A/B
     if os.environ.get("BENCH_REMAT") == "1":  # decoder-remat A/B
         config = config.replace(remat_decoder=True)
+    if os.environ.get("BENCH_REMAT_CNN") == "1":  # encoder-remat A/B (joint)
+        config = config.replace(remat_cnn=True)
 
     T = config.max_caption_length
 

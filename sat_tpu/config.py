@@ -120,6 +120,12 @@ class Config:
     # per-step keys instead of stacking T steps of residuals).
     # Numerically identical; off by default pending a measured win.
     remat_decoder: bool = False
+    # Full-encoder rematerialization under --train_cnn: backward
+    # recomputes the CNN forward from the images instead of storing every
+    # conv activation (jax.checkpoint).  Trades ~one extra encoder
+    # forward for the activation footprint that otherwise caps joint-
+    # training batch size.  Numerically identical; off by default.
+    remat_cnn: bool = False
     mesh_shape: Tuple[int, ...] = (1, 1)   # (data, model) device mesh
     mesh_axes: Tuple[str, ...] = ("data", "model")
     context_parallel: int = 1          # shard the context grid over 'model'

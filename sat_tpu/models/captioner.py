@@ -71,12 +71,22 @@ def encode(
 
     new_state: Dict[str, Any] = {}
     if train and "batch_stats" in cnn_vars:
-        contexts, mutated = encoder.apply(
-            cnn_vars, images, train=True, mutable=["batch_stats"]
+        apply_bn = lambda v, im: encoder.apply(  # noqa: E731
+            v, im, train=True, mutable=["batch_stats"]
         )
+        if config.remat_cnn:
+            apply_bn = jax.checkpoint(apply_bn)
+        contexts, mutated = apply_bn(cnn_vars, images)
         new_state["batch_stats"] = mutated["batch_stats"]
     else:
-        contexts = encoder.apply(cnn_vars, images, train=False)
+        apply_fn = lambda v, im: encoder.apply(v, im, train=False)  # noqa: E731
+        if train and config.remat_cnn:
+            # full encoder remat: backward recomputes the CNN forward from
+            # the images instead of storing every conv activation — the
+            # memory lever that buys joint-training batch size (the conv1/2
+            # stacks at 224^2 dominate live activation footprint)
+            apply_fn = jax.checkpoint(apply_fn)
+        contexts = apply_fn(cnn_vars, images)
     if not train:
         contexts = jax.lax.stop_gradient(contexts)
     return contexts, new_state
