@@ -17,6 +17,16 @@ from typing import List, Optional
 from .config import Config
 
 
+# The reference's config attributes are literally misspelled
+# (/root/reference/config.py:12-13: "num_initalize_layers",
+# "dim_initalize_layer"); accept those spellings so its users' override
+# lists port verbatim.
+_REFERENCE_KEY_ALIASES = {
+    "num_initalize_layers": "num_initialize_layers",
+    "dim_initalize_layer": "dim_initialize_layer",
+}
+
+
 def _parse_override(config: Config, key: str, raw: str):
     fields = {f.name: f for f in dataclasses.fields(Config)}
     if key not in fields:
@@ -93,6 +103,7 @@ def build_config(argv: Optional[List[str]] = None):
         if "=" not in item:
             raise SystemExit(f"--set expects KEY=VALUE, got {item!r}")
         key, raw = item.split("=", 1)
+        key = _REFERENCE_KEY_ALIASES.get(key, key)
         overrides[key] = _parse_override(config, key, raw)
     if overrides:
         config = config.replace(**overrides)

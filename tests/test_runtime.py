@@ -498,3 +498,14 @@ def test_quality_run_loss_curve_keeps_final_segment(tmp_path):
     assert steps[-1] == 1200
     assert all(b > a for a, b in zip(steps, steps[1:]))
     assert all(loss == 2.0 for _, loss in read_loss_curve(str(p)))
+
+
+def test_cli_accepts_reference_misspelled_keys():
+    """The reference's config attributes are literally typo'd
+    (num_initalize_layers, /root/reference/config.py:12-13); its users'
+    override lists must port verbatim."""
+    config, _ = build_config(
+        ["--set", "num_initalize_layers=1", "--set", "dim_initalize_layer=64"]
+    )
+    assert config.num_initialize_layers == 1
+    assert config.dim_initialize_layer == 64
