@@ -137,7 +137,8 @@ def main() -> None:
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))
     n_steps = int(os.environ.get("BENCH_STEPS", "10"))
     train_cnn = os.environ.get("BENCH_TRAIN_CNN", "0") == "1"
-    config = Config(batch_size=B, train_cnn=train_cnn)
+    cnn = os.environ.get("BENCH_CNN", "vgg16")  # or resnet50
+    config = Config(batch_size=B, train_cnn=train_cnn, cnn=cnn)
     if "BENCH_RNG_IMPL" in os.environ:  # e.g. threefry2x32, to rerun the
         config = config.replace(rng_impl=os.environ["BENCH_RNG_IMPL"])  # PERF.md A/B
     if os.environ.get("BENCH_REMAT") == "1":  # decoder-remat A/B
@@ -193,7 +194,7 @@ def main() -> None:
     log(f"{captions_per_sec:.2f} captions/sec ({step_ms:.1f} ms/step)")
 
     baseline = None
-    if not train_cnn:
+    if not train_cnn and cnn == "vgg16":
         # the recorded baseline is the frozen-CNN configuration; a joint
         # CNN+RNN run is a different workload, not a regression against it
         try:
@@ -211,6 +212,7 @@ def main() -> None:
         "step_time_ms": round(step_ms, 2),
         "batch_size": B,
         "train_cnn": train_cnn,
+        "cnn": cnn,
         "compile_s": round(compile_s, 1),
         "device_kind": getattr(device, "device_kind", device.platform),
     }
