@@ -41,6 +41,21 @@ if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_eval.json" ]; then
   echo "STAGE FAILED: bench_eval (rc=$rc)"; FAILED="$FAILED bench_eval"
 fi
 
+echo "=== stage 1c: A/B knobs (dropout PRNG, decoder/encoder remat, resnet50) ==="
+for label in "rng_threefry BENCH_RNG_IMPL=threefry2x32" \
+             "remat_decoder BENCH_REMAT=1" \
+             "remat_cnn_joint BENCH_TRAIN_CNN=1 BENCH_REMAT_CNN=1" \
+             "resnet50 BENCH_CNN=resnet50"; do
+  name=${label%% *}; envs=${label#* }
+  echo "--- $name ($envs) ---"
+  env $envs BENCH_EVAL=0 BENCH_WATCHDOG_S=480 timeout 500 python bench.py \
+    2>"$OUT/bench_$name.log" | tee "$OUT/bench_$name.json"
+  rc=${PIPESTATUS[0]}
+  if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_$name.json" ]; then
+    echo "STAGE FAILED: bench_$name (rc=$rc)"; FAILED="$FAILED bench_$name"
+  fi
+done
+
 echo "=== stage 2: pallas attention measurement ==="
 timeout 500 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
 rc=${PIPESTATUS[0]}
