@@ -259,3 +259,27 @@ class TestDataSetProperties:
         ds2 = mk()
         ds2.seek(epoch, offset)
         assert [tuple(b) for b in ds2] == epochs[epoch][offset:]
+
+
+def test_prefetch_loader_surfaces_worker_errors(coco_fixture, tmp_path):
+    """A missing/corrupt image mid-epoch must raise on the consumer side
+    (not hang the queue or silently skip the batch)."""
+    import shutil
+
+    from sat_tpu.data import PrefetchLoader
+
+    cfg = coco_fixture["config"]
+    # private image dir so deleting a file can't break sibling tests
+    img_dir = tmp_path / "images"
+    shutil.copytree(cfg.train_image_dir, img_dir)
+    cfg = cfg.replace(
+        train_image_dir=str(img_dir),
+        temp_annotation_file=str(tmp_path / "anns.csv"),
+        temp_data_file=str(tmp_path / "data.npy"),
+    )
+    ds = prepare_train_data(cfg)
+    victim = sorted(img_dir.iterdir())[2]
+    victim.unlink()
+    with pytest.raises(FileNotFoundError):
+        for _ in PrefetchLoader(ds, num_workers=2, prefetch_depth=2):
+            pass
