@@ -84,6 +84,11 @@ def build_config(argv: Optional[List[str]] = None):
         "--set", action="append", default=[], metavar="KEY=VALUE",
         help="override any Config field, repeatable",
     )
+    p.add_argument(
+        "--print_config", action="store_true",
+        help="print the fully resolved Config as JSON and exit (audits "
+             "--set stacks and env path re-rooting without running)",
+    )
     args = p.parse_args(argv)
     if args.sweep and args.phase != "eval":
         raise SystemExit("--sweep only applies to --phase=eval")
@@ -117,6 +122,7 @@ def build_config(argv: Optional[List[str]] = None):
         "load_cnn": args.load_cnn,
         "cnn_model_file": args.cnn_model_file,
         "sweep": args.sweep,
+        "print_config": args.print_config,
     }
     return config, cli
 
@@ -153,6 +159,12 @@ def _arm_device_watchdog() -> "callable":
 
 def main(argv: Optional[List[str]] = None) -> int:
     config, cli = build_config(argv)
+
+    if cli["print_config"]:
+        import json
+
+        print(json.dumps(config.to_dict(), indent=2, sort_keys=True))
+        return 0
 
     # multi-host bootstrap first, before any other jax use (no-op unless a
     # launcher/env signals a cluster — see parallel.mesh)

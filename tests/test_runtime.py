@@ -509,3 +509,12 @@ def test_cli_accepts_reference_misspelled_keys():
     )
     assert config.num_initialize_layers == 1
     assert config.dim_initialize_layer == 64
+
+
+def test_cli_print_config(capsys):
+    from sat_tpu.cli import main
+
+    assert main(["--print_config", "--set", "batch_size=11"]) == 0
+    cfg = json.loads(capsys.readouterr().out)
+    assert cfg["batch_size"] == 11
+    assert cfg["cnn"] == "vgg16"
