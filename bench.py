@@ -25,6 +25,9 @@ frozen config), BENCH_RNG_IMPL (override config.rng_impl, e.g.
 threefry2x32 to reproduce the PERF.md dropout-PRNG A/B),
 BENCH_WATCHDOG_S (hard deadline, default 540),
 BENCH_CPU=1 (pin the CPU backend for dev/smoke runs),
+BENCH_CNN=resnet50 (bench the second encoder family; vs_baseline pins
+to 1.0 off the recorded vgg16 config), BENCH_REMAT=1 / BENCH_REMAT_CNN=1
+(decoder / encoder rematerialization A/Bs),
 BENCH_EVAL=0 (skip the additive eval-decode metric; BENCH_EVAL_ITERS
 sizes its window).  When the eval-decode extras are measured, a second,
 richer JSON line is printed after the contract line.
