@@ -1,6 +1,7 @@
 """Host data layer tests: tokenizer, vocabulary, COCO index, DataSet."""
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -283,3 +284,22 @@ def test_prefetch_loader_surfaces_worker_errors(coco_fixture, tmp_path):
     with pytest.raises(FileNotFoundError):
         for _ in PrefetchLoader(ds, num_workers=2, prefetch_depth=2):
             pass
+
+
+def test_prefetch_loader_abandoned_iterator_releases_producer(coco_fixture):
+    """Breaking out of the loader mid-epoch must stop the producer thread
+    (the bounded put aborts on the consumer-gone signal) — an abandoned
+    iterator may not pin a thread or deadlock interpreter exit."""
+    import threading
+
+    from sat_tpu.data import PrefetchLoader
+
+    ds = prepare_train_data(coco_fixture["config"])
+    before = threading.active_count()
+    it = iter(PrefetchLoader(ds, num_workers=2, prefetch_depth=1))
+    next(it)
+    it.close()  # generator finalizer sets the stop event
+    deadline = time.time() + 10
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
