@@ -299,3 +299,38 @@ def test_alphas_off_by_default_and_costless():
     cfg, params, contexts = setup(seed=3, B=2)
     out = beam_search(params, cfg, contexts, EOS)
     assert out.alphas is None
+
+
+def test_valid_size_masks_phantom_vocab_columns():
+    """A vocabulary smaller than config.vocabulary_size leaves trailing
+    logit columns with no word (reference vocabulary.py:25-26 shrinks the
+    vocab; its word list would be indexed past the end).  With valid_size
+    set, no emitted token id may reach the phantom range."""
+    from sat_tpu.config import Config
+    from sat_tpu.models import init_decoder_params
+    from sat_tpu.ops.beam_search import beam_search_jit
+
+    config = Config(
+        vocabulary_size=50,
+        dim_embedding=16,
+        num_lstm_units=16,
+        dim_initialize_layer=16,
+        dim_attend_layer=16,
+        dim_decode_layer=32,
+        max_caption_length=6,
+        compute_dtype="float32",
+    )
+    params = init_decoder_params(jax.random.PRNGKey(0), config)
+    rng = np.random.default_rng(0)
+    contexts = jnp.asarray(rng.normal(size=(3, 8, 512)).astype(np.float32))
+
+    valid = 17
+    out = beam_search_jit(
+        params, config, contexts, eos_id=3, beam_size=3, valid_size=valid
+    )
+    words = np.asarray(out.words)
+    lengths = np.asarray(out.lengths)
+    for b in range(words.shape[0]):
+        for k in range(words.shape[1]):
+            emitted = words[b, k, : lengths[b, k]]
+            assert (emitted < valid).all(), (b, k, emitted)
