@@ -62,6 +62,10 @@ _syn_index: Optional[Dict[str, Set[int]]] = None
 _para_index: Optional[Dict[str, Set[int]]] = None
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=65536)  # corpora re-stem the same caption vocabulary
 def _stem(word: str) -> str:
     global _stemmer
     if _stemmer is None:
