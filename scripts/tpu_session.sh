@@ -7,9 +7,15 @@ OUT=${1:-/tmp/tpu_session}
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
-echo "=== stage 0: device probe ==="
-timeout 180 python -c "import jax; print(jax.devices())" || {
-  echo "TPU unreachable; aborting"; exit 3; }
+echo "=== stage 0: device probe (compute round-trip) ==="
+# listing devices is not enough: the tunneled backend has been observed
+# returning the device list while all computation hangs — require a real
+# matmul to come back
+timeout 180 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+print('probe ok:', float(jax.device_get((x @ x).sum())), jax.devices())
+" || { echo "TPU unreachable; aborting"; exit 3; }
 
 FAILED=""
 
