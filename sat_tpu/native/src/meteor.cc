@@ -269,8 +269,17 @@ double meteor_segment(const std::string& hypothesis,
                 &hyp_w, &ref_w);
 
   std::vector<std::string> hyp_stems(hyp.size()), ref_stems(ref.size());
-  for (size_t i = 0; i < hyp.size(); i++) hyp_stems[i] = porter_stem(hyp[i]);
-  for (size_t j = 0; j < ref.size(); j++) ref_stems[j] = porter_stem(ref[j]);
+  // corpus scoring re-stems the same caption vocabulary across thousands
+  // of segments; cache stems (safe: the ctypes layer serializes scoring)
+  static std::unordered_map<std::string, std::string> stem_cache;
+  auto cached_stem = [](const std::string& w) -> const std::string& {
+    auto it = stem_cache.find(w);
+    if (it == stem_cache.end())
+      it = stem_cache.emplace(w, porter_stem(w)).first;
+    return it->second;
+  };
+  for (size_t i = 0; i < hyp.size(); i++) hyp_stems[i] = cached_stem(hyp[i]);
+  for (size_t j = 0; j < ref.size(); j++) ref_stems[j] = cached_stem(ref[j]);
   run_key_stage(hyp_stems, ref_stems, &hyp_used, &ref_used, kStemWeight,
                 &matches, &hyp_w, &ref_w);
 
