@@ -6,41 +6,52 @@ encoder forward (frozen CNN, the reference's published configuration,
 backward, global-norm clip 5.0, Adam — on whatever single device JAX
 provides (the driver runs this on one real TPU chip).
 
-Prints ONE JSON line on stdout:
+Prints JSON lines on stdout of the shape
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
-and emits it IMMEDIATELY after the first timed window completes (the
-round-1 run was killed at rc=124 with zero output; every stage now logs
-progress to stderr so a timeout still leaves a diagnosable tail).
+A driver reading either the FIRST or the LAST JSON line gets a valid
+metric: the first line comes from a minimal timed window emitted as early
+as possible, later lines re-emit the same schema with fuller numbers
+(full window, then eval-decode extras).
+
+Resilience (the r01/r02 artifacts died with zero parsed output because
+the tunneled TPU backend hung during device init): the default entry
+point is a lightweight ORCHESTRATOR that never imports jax.  It probes
+the backend with a real compute round-trip in a short-timeout subprocess,
+retrying in a loop while budget remains — observed tunnel outages are
+transient — and only then runs the bench proper in a child with the
+remaining budget as its watchdog.  If nothing lands, it prints a
+machine-readable {"error": "device_unreachable", ...} line and exits 4.
 
 The reference publishes no throughput numbers (SURVEY.md §6), so
 ``vs_baseline`` is computed against ``published.train_captions_per_sec``
 in BASELINE.json when present (recorded from a prior round), else 1.0.
 
 Env knobs: BENCH_BATCH (default 32), BENCH_STEPS (default 10),
+BENCH_MIN_STEPS (minimal first-emit window, default 3),
 BENCH_WARMUP (default 2), BENCH_PEAK_TFLOPS (override chip bf16 peak for
 MFU when the device kind is unknown), BENCH_TRAIN_CNN=1 (joint CNN+RNN
 training instead of the default frozen-CNN reference configuration;
 vs_baseline is pinned to 1.0 there since the recorded baseline is the
 frozen config), BENCH_RNG_IMPL (override config.rng_impl, e.g.
 threefry2x32 to reproduce the PERF.md dropout-PRNG A/B),
-BENCH_WATCHDOG_S (hard deadline, default 540),
+BENCH_WATCHDOG_S (total budget incl. probing, default 540),
+BENCH_PROBE_TIMEOUT_S (per-probe-attempt timeout, default 120),
 BENCH_CPU=1 (pin the CPU backend for dev/smoke runs),
 BENCH_CNN=resnet50 (bench the second encoder family; vs_baseline pins
 to 1.0 off the recorded vgg16 config), BENCH_REMAT=1 / BENCH_REMAT_CNN=1
 (decoder / encoder rematerialization A/Bs),
 BENCH_EVAL=0 (skip the additive eval-decode metric; BENCH_EVAL_ITERS
-sizes its window).  When the eval-decode extras are measured, a second,
-richer JSON line is printed after the contract line.
+sizes its window).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
-
-import numpy as np
 
 _T0 = time.perf_counter()
 
@@ -48,6 +59,182 @@ _T0 = time.perf_counter()
 def log(msg: str) -> None:
     print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
+
+# ---------------------------------------------------------------------------
+# Orchestrator (default mode) — no jax import in this process, ever.
+# ---------------------------------------------------------------------------
+
+
+def _error_line(error: str, **extras) -> str:
+    err = {
+        "metric": "train_captions_per_sec",
+        "value": None,
+        "unit": "captions/sec/chip",
+        "vs_baseline": None,
+        "error": error,
+    }
+    err.update(extras)
+    return json.dumps(err)
+
+
+def orchestrate() -> int:
+    """Probe-retry-run loop inside the total BENCH_WATCHDOG_S budget.
+
+    The tunneled backend wedges *uninterruptibly* when it is down (r02:
+    `import jax` + device init hung 540s), so every touch of the backend
+    happens in a subprocess the orchestrator can kill.  Outages observed
+    so far were transient within a measurement day, hence the retry loop
+    rather than one attempt (VERDICT r02 §next-round #1).
+    """
+    budget = float(os.environ.get("BENCH_WATCHDOG_S", "540"))
+    deadline = _T0 + budget
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
+    min_run_budget = 45.0  # don't bother starting a bench child with less
+    script = os.path.abspath(__file__)
+    state = {"emitted": False, "attempts": 0, "probe_rc": None}
+
+    # Last-resort self-deadline: a child stuck in uninterruptible kernel
+    # sleep survives SIGKILL delivery until its syscall returns, which
+    # would block both subprocess.run's post-kill wait() and the stdout
+    # relay loop below past the budget — exactly the rc=124/zero-output
+    # shape this orchestrator exists to prevent.  At deadline+20s, print
+    # the error line (if no JSON landed) and exit hard.
+    def last_resort():
+        if not state["emitted"]:
+            print(
+                _error_line(
+                    "orchestrator_deadline",
+                    probe_attempts=state["attempts"],
+                    last_probe_rc=state["probe_rc"],
+                    budget_s=budget,
+                ),
+                flush=True,
+            )
+        log("ORCHESTRATOR DEADLINE: child unreapable; exiting hard")
+        os._exit(4 if not state["emitted"] else 0)
+
+    doom = threading.Timer(budget + 20.0, last_resort)
+    doom.daemon = True
+    doom.start()
+
+    def remaining() -> float:
+        return deadline - time.perf_counter()
+
+    child_failures = 0
+    last_child_rc = None
+    while remaining() > min_run_budget:
+        state["attempts"] += 1
+        t = max(10.0, min(probe_timeout, remaining() - min_run_budget))
+        log(
+            f"probe attempt {state['attempts']} "
+            f"(timeout {t:.0f}s, {remaining():.0f}s budget left)"
+        )
+        try:
+            state["probe_rc"] = subprocess.run(
+                [sys.executable, script, "--probe"], timeout=t
+            ).returncode
+        except subprocess.TimeoutExpired:
+            state["probe_rc"] = -9
+            log("probe timed out (backend unreachable or wedged)")
+        if state["probe_rc"] != 0:
+            log(f"probe failed rc={state['probe_rc']}; backing off before retry")
+            time.sleep(min(10.0, max(0.0, remaining() - min_run_budget)))
+            continue
+
+        run_budget = remaining() - 5.0
+        log(f"probe ok — launching bench child (budget {run_budget:.0f}s)")
+        env = dict(os.environ, BENCH_WATCHDOG_S=str(max(30, int(run_budget))))
+        t_child = time.perf_counter()
+        proc = subprocess.Popen(
+            [sys.executable, script, "--run"],
+            env=env,
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        # Belt over the child's own watchdog braces: if the child wedges so
+        # hard its watchdog thread can't fire, kill it from out here.
+        killer = threading.Timer(run_budget + 10.0, proc.kill)
+        killer.daemon = True
+        killer.start()
+        try:
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                print(line, flush=True)  # relay contract lines as they land
+                if line.lstrip().startswith("{"):
+                    state["emitted"] = True
+            rc = proc.wait()
+        finally:
+            killer.cancel()
+        if state["emitted"]:
+            log(f"bench child exited rc={rc} after emitting JSON — done")
+            return 0
+        child_s = time.perf_counter() - t_child
+        last_child_rc = rc
+        log(f"bench child exited rc={rc} after {child_s:.0f}s with NO JSON")
+        # A fast nonzero exit right after a healthy probe is a bug in the
+        # bench itself (import error, compile crash), not a tunnel outage —
+        # retrying forever would burn the budget and mislabel the failure.
+        if rc != 0 and child_s < 60.0:
+            child_failures += 1
+            if child_failures >= 2:
+                print(
+                    _error_line(
+                        "bench_failed",
+                        child_rc=rc,
+                        probe_attempts=state["attempts"],
+                        budget_s=budget,
+                    ),
+                    flush=True,
+                )
+                return 4
+        log("re-probing if budget remains")
+
+    # Budget exhausted.  A deterministic bench bug exits above via the
+    # fast-failure path; reaching here means probes kept failing or a
+    # child was killed mid-run (child_rc < 0) — a backend-availability
+    # failure either way.
+    print(
+        _error_line(
+            "device_unreachable",
+            probe_attempts=state["attempts"],
+            last_probe_rc=state["probe_rc"],
+            child_rc=last_child_rc,
+            budget_s=budget,
+        ),
+        flush=True,
+    )
+    return 4
+
+
+def probe() -> None:
+    """Child: prove the backend actually computes, not just lists devices.
+
+    The tunneled backend has been observed returning the device list while
+    all computation hangs (scripts/tpu_session.sh stage 0) — require a
+    matmul round-trip.
+    """
+    log("probe: importing jax")
+    import jax
+
+    if os.environ.get("BENCH_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    x = jnp.ones((256, 256))
+    val = float(jax.device_get((x @ x).sum()))
+    d = jax.devices()[0]
+    log(
+        f"probe ok: {val} platform={d.platform} "
+        f"kind={getattr(d, 'device_kind', '?')}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Bench proper (--run mode)
+# ---------------------------------------------------------------------------
 
 # bf16 peak FLOP/s per chip by accelerator generation (public spec sheets;
 # used only to report MFU next to raw throughput).
@@ -86,14 +273,8 @@ def _program_flops(compiled) -> float | None:
 
 
 def _arm_watchdog() -> "callable":
-    """Hard deadline for the whole bench (BENCH_WATCHDOG_S, default 540s).
-
-    The tunneled TPU backend can wedge with jax.devices() blocking
-    uninterruptibly (observed this round: >2h); without a watchdog the
-    driver sees rc=124 and nothing else.  Failing fast with a clear stderr
-    tail is strictly more informative.  Returns a disarm callback."""
-    import threading
-
+    """Hard deadline for the bench child (BENCH_WATCHDOG_S, set by the
+    orchestrator to its remaining budget).  Returns a disarm callback."""
     deadline = float(os.environ.get("BENCH_WATCHDOG_S", "540"))
     done = threading.Event()
 
@@ -109,7 +290,9 @@ def _arm_watchdog() -> "callable":
     return done.set
 
 
-def main() -> None:
+def run_bench() -> None:
+    import numpy as np
+
     disarm = _arm_watchdog()
     log("importing jax")
     import jax
@@ -128,8 +311,6 @@ def main() -> None:
     except Exception as e:
         log(f"compilation cache not enabled: {e!r}")
 
-    import jax.numpy as jnp
-
     from sat_tpu.config import Config
     from sat_tpu.train.step import create_train_state, make_jit_train_step
 
@@ -139,6 +320,7 @@ def main() -> None:
     B = int(os.environ.get("BENCH_BATCH", "32"))
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))
     n_steps = int(os.environ.get("BENCH_STEPS", "10"))
+    n_min = max(1, int(os.environ.get("BENCH_MIN_STEPS", "3")))
     train_cnn = os.environ.get("BENCH_TRAIN_CNN", "0") == "1"
     cnn = os.environ.get("BENCH_CNN", "vgg16")  # or resnet50
     config = Config(batch_size=B, train_cnn=train_cnn, cnn=cnn)
@@ -179,23 +361,6 @@ def main() -> None:
     log(f"compiled in {compile_s:.1f}s")
     flops_per_step = _program_flops(compiled)
 
-    log(f"warmup x{warmup}")
-    for _ in range(warmup):
-        state, metrics = compiled(state, batch, step_rng)
-        loss = float(metrics["total_loss"])  # hard host sync barrier
-        log(f"warmup step done, loss={loss:.4f}")
-
-    log(f"timing window x{n_steps}")
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = compiled(state, batch, step_rng)
-    float(metrics["total_loss"])  # sync
-    elapsed = time.perf_counter() - t0
-
-    captions_per_sec = n_steps * B / elapsed
-    step_ms = 1e3 * elapsed / n_steps
-    log(f"{captions_per_sec:.2f} captions/sec ({step_ms:.1f} ms/step)")
-
     baseline = None
     if not train_cnn and cnn == "vgg16":
         # the recorded baseline is the frozen-CNN configuration; a joint
@@ -205,33 +370,60 @@ def main() -> None:
                 baseline = json.load(f).get("published", {}).get("train_captions_per_sec")
         except (OSError, json.JSONDecodeError):
             pass
-    vs_baseline = captions_per_sec / baseline if baseline else 1.0
 
-    result = {
-        "metric": "train_captions_per_sec",
-        "value": round(captions_per_sec, 2),
-        "unit": "captions/sec/chip",
-        "vs_baseline": round(vs_baseline, 3),
-        "step_time_ms": round(step_ms, 2),
-        "batch_size": B,
-        "train_cnn": train_cnn,
-        "cnn": cnn,
-        "compile_s": round(compile_s, 1),
-        "device_kind": getattr(device, "device_kind", device.platform),
-    }
     peak = _peak_flops(device)
-    if flops_per_step is not None:
-        achieved = flops_per_step * n_steps / elapsed
-        result["tflops_per_sec"] = round(achieved / 1e12, 2)
-        if peak:
-            result["mfu"] = round(achieved / peak, 4)
-    # THE contract line — flushed the moment the first window completes
-    # (the round-1 artifact died at rc=124 with zero output; nothing may
-    # delay this print).
-    print(json.dumps(result), flush=True)
+
+    def emit(elapsed: float, steps: int, window: str) -> dict:
+        captions_per_sec = steps * B / elapsed
+        step_ms = 1e3 * elapsed / steps
+        log(f"[{window}] {captions_per_sec:.2f} captions/sec ({step_ms:.1f} ms/step)")
+        result = {
+            "metric": "train_captions_per_sec",
+            "value": round(captions_per_sec, 2),
+            "unit": "captions/sec/chip",
+            "vs_baseline": round(captions_per_sec / baseline, 3) if baseline else 1.0,
+            "step_time_ms": round(step_ms, 2),
+            "batch_size": B,
+            "train_cnn": train_cnn,
+            "cnn": cnn,
+            "compile_s": round(compile_s, 1),
+            "device_kind": getattr(device, "device_kind", device.platform),
+            "window": window,
+            "steps_measured": steps,
+        }
+        if flops_per_step is not None:
+            achieved = flops_per_step * steps / elapsed
+            result["tflops_per_sec"] = round(achieved / 1e12, 2)
+            if peak:
+                result["mfu"] = round(achieved / peak, 4)
+        print(json.dumps(result), flush=True)
+        return result
+
+    log(f"warmup x{warmup}")
+    for _ in range(warmup):
+        state, metrics = compiled(state, batch, step_rng)
+        loss = float(metrics["total_loss"])  # hard host sync barrier
+        log(f"warmup step done, loss={loss:.4f}")
+
+    # Minimal window FIRST: a parsed contract line lands within seconds of
+    # compile even if the tunnel dies mid-run (r02 lesson — nothing may
+    # delay the first JSON print).
+    log(f"minimal timing window x{n_min}")
+    t0 = time.perf_counter()
+    for _ in range(n_min):
+        state, metrics = compiled(state, batch, step_rng)
+    float(metrics["total_loss"])  # sync
+    emit(time.perf_counter() - t0, n_min, "minimal")
+
+    log(f"full timing window x{n_steps}")
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = compiled(state, batch, step_rng)
+    float(metrics["total_loss"])  # sync
+    result = emit(time.perf_counter() - t0, n_steps, "full")
 
     # Eval-decode throughput (encode + on-device batched beam search) in
-    # the same artifact.  Strictly additive AFTER the contract line: a
+    # the same artifact.  Strictly additive AFTER the contract lines: a
     # fuller JSON line is re-emitted once the extras exist, so a driver
     # reading either the first or the last JSON line gets valid metrics.
     # (BENCH_EVAL=0 disables.)
@@ -242,26 +434,30 @@ def main() -> None:
             log("eval decode: compiling encoder+beam program (beam=3)")
             eval_iters = int(os.environ.get("BENCH_EVAL_ITERS", "5"))
 
+            # BN encoders (resnet50) need running statistics at inference;
+            # thread them through or the apply fails (ADVICE r02).
+            eval_variables = {"params": state.params}
+            if state.batch_stats:
+                eval_variables["batch_stats"] = state.batch_stats
+
             @jax.jit
-            def decode(params, images):
+            def decode(variables, images):
                 from sat_tpu.models.captioner import encode
 
-                contexts, _ = encode(
-                    {"params": params}, config, images, train=False
-                )
+                contexts, _ = encode(variables, config, images, train=False)
                 out = beam_search_jit(
-                    params["decoder"], config, contexts, 1, beam_size=3
+                    variables["params"]["decoder"], config, contexts, 1, beam_size=3
                 )
                 # serializing dependency for chained timing (PERF.md)
                 return out, images + 1e-30 * out.log_scores.sum()
 
             t_c = time.perf_counter()
-            out, images_c = decode(state.params, batch["images"])
+            out, images_c = decode(eval_variables, batch["images"])
             jax.device_get(out.log_scores[0, 0])
             log(f"eval decode compiled+first in {time.perf_counter() - t_c:.1f}s")
             t0 = time.perf_counter()
             for _ in range(eval_iters):
-                out, images_c = decode(state.params, images_c)
+                out, images_c = decode(eval_variables, images_c)
             jax.device_get(out.log_scores[0, 0])
             eval_elapsed = time.perf_counter() - t0
             result["eval_images_per_sec"] = round(eval_iters * B / eval_elapsed, 2)
@@ -272,6 +468,15 @@ def main() -> None:
             log(f"eval decode bench skipped: {e!r}")
 
     disarm()
+
+
+def main() -> None:
+    if "--probe" in sys.argv:
+        probe()
+    elif "--run" in sys.argv:
+        run_bench()
+    else:
+        sys.exit(orchestrate())
 
 
 if __name__ == "__main__":

@@ -8,14 +8,10 @@ mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
 echo "=== stage 0: device probe (compute round-trip) ==="
-# listing devices is not enough: the tunneled backend has been observed
-# returning the device list while all computation hangs — require a real
-# matmul to come back
-timeout 180 python -c "
-import jax, jax.numpy as jnp
-x = jnp.ones((256, 256))
-print('probe ok:', float(jax.device_get((x @ x).sum())), jax.devices())
-" || { echo "TPU unreachable; aborting"; exit 3; }
+# bench.py --probe is the single source of the reachability check: a real
+# matmul round-trip, because the tunneled backend has been observed
+# returning the device list while all computation hangs
+timeout 180 python bench.py --probe || { echo "TPU unreachable; aborting"; exit 3; }
 
 FAILED=""
 
