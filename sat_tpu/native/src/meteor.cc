@@ -271,11 +271,15 @@ double meteor_segment(const std::string& hypothesis,
   std::vector<std::string> hyp_stems(hyp.size()), ref_stems(ref.size());
   // corpus scoring re-stems the same caption vocabulary across thousands
   // of segments; cache stems (safe: the ctypes layer serializes scoring)
+  // bounded (the Python twin uses lru_cache(65536)): an open-ended
+  // vocabulary in a long-lived process must not grow it without limit
   static std::unordered_map<std::string, std::string> stem_cache;
   auto cached_stem = [](const std::string& w) -> const std::string& {
     auto it = stem_cache.find(w);
-    if (it == stem_cache.end())
+    if (it == stem_cache.end()) {
+      if (stem_cache.size() >= 65536) stem_cache.clear();
       it = stem_cache.emplace(w, porter_stem(w)).first;
+    }
     return it->second;
   };
   for (size_t i = 0; i < hyp.size(); i++) hyp_stems[i] = cached_stem(hyp[i]);

@@ -240,3 +240,163 @@ class TestMeteorParaphrase:
             want = py_meteor.score_from_stats(py_meteor.segment_stats(hyp, ref))
             got = native.meteor_segment(hyp, ref)
             assert got == pytest.approx(want, abs=1e-12), (hyp, ref)
+
+
+class TestMeteorGoldenFixtures:
+    """Externally-grounded METEOR fixtures (VERDICT r02 §next-round #3).
+
+    The jar and its tables are absent offline (the reference ships neither,
+    .MISSING_LARGE_BLOBS), so the external anchor is the *published* METEOR
+    1.5 specification (Denkowski & Lavie 2014, "Meteor Universal"): the
+    scoring equations with the English rank-task parameters α=.85, β=.2,
+    γ=.6, δ=.75 and stage weights exact 1.0 / stem 0.6 / synonym 0.8 /
+    paraphrase 0.6.  Every case below asserts (a) the alignment statistics
+    — so a change to the bundled tables breaks the test loudly instead of
+    silently shifting the golden value — and (b) the score, derived by
+    hand from the published equations and written out as literal
+    arithmetic, on BOTH backends.
+    """
+
+    CASES = [
+        # (hyp, ref, matches, chunks, P, R, expected-score expression)
+        # exact-only, 2 chunks: matched dog/in/park; P=R=(.75*2+.25*1)/(.75*3+.25*1)
+        (
+            "dog runs in park",
+            "dog walks in park",
+            3.0, 2.0, 1.75 / 2.5, 1.75 / 2.5,
+            (1.75 / 2.5) * (1.0 - 0.6 * (2.0 / 3.0) ** 0.2),
+        ),
+        # stem weight .6: dogs~dog, play~plays at stem stage, happily exact;
+        # all content, one chunk (full coverage → no fragmentation penalty)
+        (
+            "dogs play happily",
+            "dog plays happily",
+            3.0, 1.0, (0.75 * 2.2) / (0.75 * 3), (0.75 * 2.2) / (0.75 * 3),
+            (0.75 * 2.2) / (0.75 * 3),
+        ),
+        # synonym weight .8: hound~dog from the bundled synset; a=function
+        (
+            "a hound runs",
+            "a dog runs",
+            3.0, 1.0, (0.75 * 1.8 + 0.25 * 1.0) / 1.75,
+            (0.75 * 1.8 + 0.25 * 1.0) / 1.75,
+            (0.75 * 1.8 + 0.25 * 1.0) / 1.75,
+        ),
+        # paraphrase span weight .6: 'hot dog' (2 words) ~ 'frankfurter'
+        # (1 word); m = avg matched words = (3+2)/2; single chunk
+        (
+            "a hot dog",
+            "a frankfurter",
+            2.5, 1.0, (0.75 * 1.2 + 0.25 * 1.0) / 1.75,
+            (0.75 * 0.6 + 0.25 * 1.0) / 1.0,
+            None,  # Fmean computed from P,R below
+        ),
+        # stage ordering: running~runs matches at the STEM stage (before
+        # the paraphrase stage can claim 'is running'~'runs'), leaving
+        # 'is' unmatched → 2 chunks
+        (
+            "a man is running",
+            "a man runs",
+            3.0, 2.0, (0.75 * 1.6 + 0.25 * 1.0) / 2.0,
+            (0.75 * 1.6 + 0.25 * 1.0) / 1.75,
+            None,
+        ),
+        # no overlap → 0
+        ("red square glows", "blue circle hums", 0.0, 0.0, 0.0, 0.0, 0.0),
+    ]
+
+    @staticmethod
+    def _published_score(p, r, matches, chunks):
+        # Denkowski & Lavie 2014 eqs. (en rank task): Fmean = P·R/(αP+(1−α)R),
+        # Pen = γ·(ch/m)^β, Score = Fmean·(1−Pen); identical/contiguous
+        # full-coverage alignments carry no penalty (identity → 1.0).
+        if matches == 0 or p == 0 or r == 0:
+            return 0.0
+        fmean = (p * r) / (0.85 * p + 0.15 * r)
+        if chunks <= 1:
+            return fmean
+        return fmean * (1.0 - 0.6 * (chunks / matches) ** 0.2)
+
+    def test_identity_scores_exactly_one_both_backends(self):
+        from sat_tpu import native
+        from sat_tpu.evalcap.meteor import meteor_single
+
+        sent = "a large brown dog chases the ball"
+        assert meteor_single(sent, [sent]) == pytest.approx(1.0, abs=1e-12)
+        if native.available():
+            assert native.meteor_segment(sent, sent) == pytest.approx(1.0, abs=1e-12)
+
+    @pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+    def test_golden_case_both_backends(self, case):
+        from sat_tpu import native
+        from sat_tpu.evalcap.meteor import score_from_stats, segment_stats
+
+        hyp, ref, matches, chunks, p, r, expected = case
+        stats = segment_stats(hyp, ref)
+        # (a) pin the alignment, so table drift fails loudly
+        assert stats["matches"] == pytest.approx(matches)
+        assert stats["chunks"] == pytest.approx(chunks)
+        assert stats["p"] == pytest.approx(p, abs=1e-12)
+        assert stats["r"] == pytest.approx(r, abs=1e-12)
+        # (b) the score equals the published formula evaluated by hand
+        if expected is None:
+            expected = self._published_score(p, r, matches, chunks)
+        assert score_from_stats(stats) == pytest.approx(expected, abs=1e-12)
+        if native.available():
+            assert native.meteor_segment(hyp, ref) == pytest.approx(
+                expected, abs=1e-12
+            ), (hyp, ref)
+
+    def test_compact_table_bias_is_bounded_and_measured(self, monkeypatch):
+        """Quantify the synonym/paraphrase compact-table contribution.
+
+        The docstring divergence note (sat_tpu/evalcap/meteor.py) cites the
+        numbers measured here: on a 12-pair caption corpus exercising every
+        stage, disabling the bundled tables (= the score every out-of-table
+        pair gets) moves the corpus mean DOWN by ≈0.29 and individual
+        in-table segments by up to ≈0.69 (a short segment whose only
+        content-word links are synonym/paraphrase matches).  Those are the
+        per-segment bounds on the divergence vs the jar's bigger tables:
+        a pair the jar matches but our table lacks biases that segment LOW
+        by at most the measured max; a curated pair the jar lacks biases
+        it HIGH by the same bound.  Tables only ever ADD credit (later
+        stages touch only unmatched words), so table absence is one-sided.
+        """
+        from sat_tpu.evalcap import meteor as m
+
+        corpus = [
+            ("a hound runs", "a dog runs"),                      # synonym
+            ("a hot dog", "a frankfurter"),                      # paraphrase
+            ("a man rides a bicycle", "a man rides a bike"),     # synonym
+            ("dogs play happily", "dog plays happily"),          # stem only
+            ("dog runs in park", "dog walks in park"),           # exact only
+            ("a man is running", "a man runs"),                  # stem
+            ("the kids frolic", "the children play"),            # syn pair
+            ("a cat atop a car", "a cat on top of a car"),       # paraphrase
+            ("red square glows", "blue circle hums"),            # none
+            ("a big lake", "a large pond"),                      # curated pair
+            ("the meal was tasty", "the food was delicious"),    # syn pair
+            ("people near a bus", "people beside a bus"),        # syn/par
+        ]
+
+        def corpus_mean():
+            return sum(
+                m.score_from_stats(m.segment_stats(h, r)) for h, r in corpus
+            ) / len(corpus)
+
+        full = corpus_mean()
+        per_full = [m.score_from_stats(m.segment_stats(h, r)) for h, r in corpus]
+        monkeypatch.setattr(m, "_synonyms", lambda: {})
+        monkeypatch.setattr(m, "_paraphrases", lambda: {})
+        bare = corpus_mean()
+        per_bare = [m.score_from_stats(m.segment_stats(h, r)) for h, r in corpus]
+
+        delta = full - bare
+        max_seg = max(a - b for a, b in zip(per_full, per_bare))
+        # tables only ever ADD credit (later stages touch only unmatched
+        # words), so the bias direction of table *absence* is down
+        assert all(a >= b - 1e-12 for a, b in zip(per_full, per_bare))
+        # measured magnitudes, recorded in the meteor.py divergence note
+        # (mean 0.287 / max 0.686 when recorded; bands allow table edits)
+        assert 0.15 < delta < 0.45, f"corpus-mean table delta drifted: {delta}"
+        assert 0.5 < max_seg < 0.8, f"max per-segment table delta drifted: {max_seg}"
