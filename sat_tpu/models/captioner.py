@@ -58,26 +58,43 @@ def encode(
     config: Config,
     images: jnp.ndarray,
     train: bool = False,
+    collect_activity: bool = False,
 ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
     """images [B,224,224,3] → contexts [B,N,D].  Returns (contexts, new_model_state).
 
     train here means *the CNN is training* (train_cnn): enables BN batch
     statistics and gradient flow; otherwise contexts are stop-gradiented so
-    the frozen CNN never enters the backward pass."""
+    the frozen CNN never enters the backward pass.
+
+    collect_activity=True (static) additionally sums the 'activity'
+    collection the Conv layers sow (Σ|relu output| per activated conv —
+    VGG16 only; ResNet convs pass activation=None like the reference,
+    utils/nn.py:55-57) into new_state['activity_l1']."""
     encoder = make_encoder(config)
     cnn_vars: Dict[str, Any] = {"params": variables["params"]["cnn"]}
     if "batch_stats" in variables:
         cnn_vars["batch_stats"] = variables["batch_stats"]
 
     new_state: Dict[str, Any] = {}
+    mutable = []
     if train and "batch_stats" in cnn_vars:
-        apply_bn = lambda v, im: encoder.apply(  # noqa: E731
-            v, im, train=True, mutable=["batch_stats"]
+        mutable.append("batch_stats")
+    if collect_activity:
+        mutable.append("activity")
+    if mutable:
+        bn = "batch_stats" in mutable
+        apply_mut = lambda v, im: encoder.apply(  # noqa: E731
+            v, im, train=bn, mutable=list(mutable)
         )
-        if config.remat_cnn:
-            apply_bn = jax.checkpoint(apply_bn)
-        contexts, mutated = apply_bn(cnn_vars, images)
-        new_state["batch_stats"] = mutated["batch_stats"]
+        if train and config.remat_cnn:
+            apply_mut = jax.checkpoint(apply_mut)
+        contexts, mutated = apply_mut(cnn_vars, images)
+        if bn:
+            new_state["batch_stats"] = mutated["batch_stats"]
+        if collect_activity:
+            new_state["activity_l1"] = jax.tree_util.tree_reduce(
+                lambda a, b: a + b, mutated.get("activity", {}), jnp.float32(0)
+            )
     else:
         apply_fn = lambda v, im: encoder.apply(v, im, train=False)  # noqa: E731
         if train and config.remat_cnn:
@@ -108,29 +125,34 @@ def compute_loss(
     """
     if train and rng is None:
         raise ValueError("compute_loss(train=True) requires an rng for dropout")
-    if (
-        config.fc_activity_regularizer_scale > 0
-        or config.conv_activity_regularizer_scale > 0
-    ):
-        raise NotImplementedError(
-            "L1 activity regularization (reference utils/nn.py:23-26,40-43) is "
-            "not implemented; both scales default to 0.0 in the reference too. "
-            "Set them to 0."
-        )
+    # L1 activity regularization gates (reference utils/nn.py:23-26,40-43):
+    # fc activity when training, conv activity only when the CNN trains.
+    fc_act_scale = config.fc_activity_regularizer_scale if train else 0.0
     train_cnn = train and config.train_cnn
+    conv_act_scale = config.conv_activity_regularizer_scale if train_cnn else 0.0
     if "contexts" in batch:
         contexts, new_state = batch["contexts"], {}
     else:
-        contexts, new_state = encode(variables, config, batch["images"], train_cnn)
+        contexts, new_state = encode(
+            variables, config, batch["images"], train_cnn,
+            collect_activity=conv_act_scale > 0,
+        )
+    conv_activity = new_state.pop("activity_l1", jnp.float32(0))
 
     sentences = batch["word_idxs"]
     masks = batch["masks"].astype(jnp.float32)
     B, T = sentences.shape
     N = contexts.shape[1]
 
-    logits, alphas = teacher_forced_decode(
-        variables["params"]["decoder"], config, contexts, sentences, train, rng
-    )  # [B,T,V], [B,T,N]
+    decoded = teacher_forced_decode(
+        variables["params"]["decoder"], config, contexts, sentences, train, rng,
+        with_activity=fc_act_scale > 0,
+    )  # [B,T,V], [B,T,N] (+ activity L1)
+    fc_activity = jnp.float32(0)
+    if fc_act_scale > 0:
+        logits, alphas, fc_activity = decoded
+    else:
+        logits, alphas = decoded
 
     # masked sparse softmax cross-entropy, summed / mask-sum (model.py:316-318)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -153,6 +175,9 @@ def compute_loss(
         conv_scale=config.conv_kernel_regularizer_scale,
         train_cnn=train_cnn,
     )
+    # activity terms join the same reg bucket the reference sums via
+    # tf.losses.get_regularization_loss() (model.py:328)
+    reg_loss = reg_loss + fc_act_scale * fc_activity + conv_act_scale * conv_activity
 
     total_loss = cross_entropy_loss + attention_loss + reg_loss
 

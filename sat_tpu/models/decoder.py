@@ -155,6 +155,13 @@ def _dropout(rng, x, rate, train):
     return _nn_dropout(x, rate, deterministic=not train, rng=rng)
 
 
+def _l1(x):
+    """L1 activity contribution of an activated layer output — TF1
+    l1_regularizer semantics: Σ|x|, unnormalized (reference
+    utils/nn.py:23-26,40-43; scale applied by the caller)."""
+    return jnp.abs(x.astype(jnp.float32)).sum()
+
+
 def lstm_step(
     p: Params,
     c: jnp.ndarray,
@@ -178,12 +185,16 @@ def init_state(
     contexts: jnp.ndarray,
     train: bool = False,
     rng: Optional[jax.Array] = None,
+    with_activity: bool = False,
 ) -> DecoderState:
-    """LSTM state from the mean context (reference initialize, model.py:358-393)."""
+    """LSTM state from the mean context (reference initialize, model.py:358-393).
+
+    with_activity=True (static) returns (state, L1 of the tanh outputs)."""
     p = params["initialize"]
     rate = config.fc_drop_rate
     dt = jnp.dtype(config.compute_dtype)
     context_mean = contexts.mean(axis=1)
+    act = jnp.float32(0)
     if train:
         k0, k1, k2 = jax.random.split(rng, 3)
         context_mean = _dropout(k0, context_mean, rate, train)
@@ -193,12 +204,15 @@ def init_state(
     else:
         ta = _dense(p["fc_a1"], context_mean, activation="tanh", dtype=dt)
         tb = _dense(p["fc_b1"], context_mean, activation="tanh", dtype=dt)
+        act = _l1(ta) + _l1(tb)  # pre-dropout, as in TF (activity attaches
+        # to the dense layer's output; dropout is a separate later layer)
         if train:
             ta = _dropout(k1, ta, rate, train)
             tb = _dropout(k2, tb, rate, train)
         memory = _dense(p["fc_a2"], ta, dtype=dt)
         output = _dense(p["fc_b2"], tb, dtype=dt)
-    return DecoderState(memory=memory, output=output, recurrent=output)
+    state = DecoderState(memory=memory, output=output, recurrent=output)
+    return (state, act) if with_activity else state
 
 
 def attend(
@@ -208,6 +222,7 @@ def attend(
     output: jnp.ndarray,
     train: bool = False,
     rng: Optional[jax.Array] = None,
+    with_activity: bool = False,
 ) -> jnp.ndarray:
     """Soft attention over the context grid → alpha [B, N]
     (reference attend, model.py:395-436).
@@ -215,17 +230,21 @@ def attend(
     The inference path delegates to precompute_attend +
     attend_with_precomputed so there is exactly ONE implementation of the
     inference math (the hoisted one beam search uses); only the
-    training/dropout path lives here."""
+    training/dropout path lives here.
+
+    with_activity=True (static) additionally returns the L1 activity sum
+    of the tanh layer outputs (see compute_loss)."""
     p = params["attend"]
     rate = config.fc_drop_rate
     dt = jnp.dtype(config.compute_dtype)
     if not train:
         proj = precompute_attend(params, config, contexts)
         _, alpha = attend_with_precomputed(params, config, contexts, proj, output)
-        return alpha
+        return (alpha, jnp.float32(0)) if with_activity else alpha
     kc, ko, kt = jax.random.split(rng, 3)
     contexts = _dropout(kc, contexts, rate, train)
     output = _dropout(ko, output, rate, train)
+    act = jnp.float32(0)
     if config.num_attend_layers == 1:
         # ctx→1 per position (no bias) + position-specific h→N projection
         logits1 = _dense(p["fc_a"], contexts, dtype=dt)[..., 0]    # [B, N]
@@ -234,10 +253,15 @@ def attend(
     else:
         t1 = _dense(p["fc_1a"], contexts, activation="tanh", dtype=dt)  # [B, N, da]
         t2 = _dense(p["fc_1b"], output, activation="tanh", dtype=dt)    # [B, da]
+        # L1 activity sites: the tanh layer outputs, pre-dropout (the
+        # reference attaches l1_regularizer only to activation≠None
+        # layers, utils/nn.py:39-43 + model.py:417-429)
+        act = _l1(t1) + _l1(t2)
         temp = t1 + t2[:, None, :]
         temp = _dropout(kt, temp, rate, train)
         logits = _dense(p["fc_2"], temp, dtype=dt)[..., 0]     # [B, N]
-    return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    alpha = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return (alpha, act) if with_activity else alpha
 
 
 def precompute_attend(
@@ -304,21 +328,28 @@ def decode_logits(
     expanded_output: jnp.ndarray,
     train: bool = False,
     rng: Optional[jax.Array] = None,
+    with_activity: bool = False,
 ) -> jnp.ndarray:
     """concat(output, context, word_embed) → vocab logits
-    (reference decode, model.py:438-459)."""
+    (reference decode, model.py:438-459).
+
+    with_activity=True (static) returns (logits, L1 of the tanh output)."""
     p = params["decode"]
     rate = config.fc_drop_rate
     dt = jnp.dtype(config.compute_dtype)
+    act = jnp.float32(0)
     if train:
         k0, k1 = jax.random.split(rng)
         expanded_output = _dropout(k0, expanded_output, rate, train)
     if config.num_decode_layers == 1:
-        return _dense(p["fc"], expanded_output, dtype=dt)
+        logits = _dense(p["fc"], expanded_output, dtype=dt)
+        return (logits, act) if with_activity else logits
     temp = _dense(p["fc_1"], expanded_output, activation="tanh", dtype=dt)
+    act = _l1(temp)
     if train:
         temp = _dropout(k1, temp, rate, train)
-    return _dense(p["fc_2"], temp, dtype=dt)
+    logits = _dense(p["fc_2"], temp, dtype=dt)
+    return (logits, act) if with_activity else logits
 
 
 def decoder_step(
@@ -330,10 +361,12 @@ def decoder_step(
     train: bool = False,
     rng: Optional[jax.Array] = None,
     ctx_proj: Optional[jnp.ndarray] = None,
+    with_activity: bool = False,
 ) -> Tuple[DecoderState, jnp.ndarray, jnp.ndarray]:
     """One decoder step: attend → embed → LSTM → logits.
 
-    Returns (new_state, logits [B, V], alpha [B, N]).  ``state.output`` must
+    Returns (new_state, logits [B, V], alpha [B, N]) — plus the step's L1
+    activity sum when with_activity=True (static).  ``state.output`` must
     be the post-dropout h when training, matching the reference where the
     DropoutWrapper's output feeds the next attend (model.py:262,307).
 
@@ -346,13 +379,19 @@ def decoder_step(
     else:
         k_att = k_in = k_out = k_state = k_dec = None
     ldr = config.lstm_drop_rate
+    act = jnp.float32(0)
 
     if ctx_proj is not None and not train:
         context, alpha = attend_with_precomputed(
             params, config, contexts, ctx_proj, state.output
         )
     else:
-        alpha = attend(params, config, contexts, state.output, train, k_att)
+        alpha = attend(
+            params, config, contexts, state.output, train, k_att,
+            with_activity=with_activity,
+        )
+        if with_activity:
+            alpha, act = alpha
         context = (contexts * alpha[..., None]).sum(axis=1)      # [B, D]
 
     word_embed = params["word_embedding"]["weights"][word]        # [B, E]
@@ -368,9 +407,14 @@ def decoder_step(
     recurrent_h = _dropout(k_state, new_h, ldr, train)
 
     expanded = jnp.concatenate([emitted, context, word_embed], axis=-1)
-    logits = decode_logits(params, config, expanded, train, k_dec)
-
-    return DecoderState(memory=new_c, output=emitted, recurrent=recurrent_h), logits, alpha
+    logits = decode_logits(
+        params, config, expanded, train, k_dec, with_activity=with_activity
+    )
+    new_state = DecoderState(memory=new_c, output=emitted, recurrent=recurrent_h)
+    if with_activity:
+        logits, dec_act = logits
+        return new_state, logits, alpha, act + dec_act
+    return new_state, logits, alpha
 
 
 def teacher_forced_decode(
@@ -380,11 +424,15 @@ def teacher_forced_decode(
     sentences: jnp.ndarray,
     train: bool = False,
     rng: Optional[jax.Array] = None,
+    with_activity: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Full training-time unroll as one lax.scan.
 
     contexts [B, N, D]; sentences [B, T] int32.
-    Returns (logits [B, T, V], alphas [B, T, N]).
+    Returns (logits [B, T, V], alphas [B, T, N]) — plus the summed L1
+    activity of every tanh layer output across init + all T steps when
+    with_activity=True (static), matching the reference's unrolled graph
+    where each step's dense layers contribute to REGULARIZATION_LOSSES.
     """
     B, T = sentences.shape
     if rng is None:
@@ -395,7 +443,12 @@ def teacher_forced_decode(
             )
         rng = jax.random.PRNGKey(0)  # never consumed when train=False
     k_init, k_steps = jax.random.split(rng)
-    state = init_state(params, config, contexts, train, k_init)
+    state = init_state(
+        params, config, contexts, train, k_init, with_activity=with_activity
+    )
+    init_act = jnp.float32(0)
+    if with_activity:
+        state, init_act = state
 
     # input word at step t is sentences[:, t-1]; step 0 gets <start>=0
     words_in = jnp.concatenate(
@@ -405,9 +458,14 @@ def teacher_forced_decode(
 
     def body(state, xs):
         word_t, rng_t = xs
-        state, logits, alpha = decoder_step(
-            params, config, contexts, state, word_t, train, rng_t
+        out = decoder_step(
+            params, config, contexts, state, word_t, train, rng_t,
+            with_activity=with_activity,
         )
+        if with_activity:
+            state, logits, alpha, act = out
+            return state, (logits, alpha, act)
+        state, logits, alpha = out
         return state, (logits, alpha)
 
     if train and config.remat_decoder:
@@ -423,8 +481,14 @@ def teacher_forced_decode(
             prevent_cse=False,
         )
 
-    _, (logits, alphas) = jax.lax.scan(
-        body, state, (words_in.T, step_rngs)
-    )
-    # scan stacks along time-major; restore batch-major
+    _, ys = jax.lax.scan(body, state, (words_in.T, step_rngs))
+    if with_activity:
+        logits, alphas, acts = ys
+        # scan stacks along time-major; restore batch-major
+        return (
+            logits.transpose(1, 0, 2),
+            alphas.transpose(1, 0, 2),
+            init_act + acts.sum(),
+        )
+    logits, alphas = ys
     return logits.transpose(1, 0, 2), alphas.transpose(1, 0, 2)

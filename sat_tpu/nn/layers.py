@@ -62,6 +62,15 @@ class Conv(nn.Module):
         )(x)
         if self.activation == "relu":
             x = nn.relu(x)
+            # L1 activity hook (reference utils/nn.py:23-26,55-57: the
+            # activity regularizer attaches only to *activated* convs —
+            # ResNet convs pass activation=None and never collect).  sow
+            # is a no-op (and the sum DCE'd) unless the caller requests
+            # the 'activity' collection as mutable.
+            self.sow(
+                "activity", "l1", jnp.abs(x.astype(jnp.float32)).sum(),
+                reduce_fn=lambda a, b: a + b, init_fn=lambda: jnp.float32(0),
+            )
         return x
 
 

@@ -38,6 +38,7 @@ from ..models.decoder import (
     DecoderState,
     _dense,
     _dropout,
+    _l1,
     decode_logits,
     lstm_step,
 )
@@ -56,15 +57,20 @@ def _cp_attend(
     output: jnp.ndarray,
     train: bool,
     rng: Optional[jax.Array],
+    with_activity: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Distributed soft attention.  ctx_local: [B, N_local, D] (this
     shard's block).  Returns (context [B, D] replicated, alpha_local
-    [B, N_local])."""
+    [B, N_local]) — plus, when with_activity (static), the L1 activity
+    partials as (ctx_sharded, model_replicated): the t1 sum is a
+    per-context-shard partial (psum over AXIS and 'data' at the end),
+    the t2 sum is replicated across AXIS (psum over 'data' only)."""
     p = params["attend"]
     rate = config.fc_drop_rate
     dt = jnp.dtype(config.compute_dtype)
     idx = jax.lax.axis_index(AXIS)
     n_local = ctx_local.shape[1]
+    act_ctx = act_rep = jnp.float32(0)
 
     if train:
         kc, ko, kt = jax.random.split(rng, 3)
@@ -85,6 +91,8 @@ def _cp_attend(
     else:
         t1 = _dense(p["fc_1a"], ctx_in, activation="tanh", dtype=dt)   # [B,Nl,da]
         t2 = _dense(p["fc_1b"], output, activation="tanh", dtype=dt)   # [B,da]
+        if with_activity:
+            act_ctx, act_rep = _l1(t1), _l1(t2)
         temp = t1 + t2[:, None, :]
         if train:
             temp = _dropout(jax.random.fold_in(kt, idx), temp, rate, train)
@@ -107,6 +115,8 @@ def _cp_attend(
     context = jax.lax.psum(
         (ctx_local * alpha_local[..., None]).sum(axis=1), AXIS
     )                                                                # [B,D]
+    if with_activity:
+        return context, alpha_local, (act_ctx, act_rep)
     return context, alpha_local
 
 
@@ -118,18 +128,28 @@ def _cp_decoder_step(
     word: jnp.ndarray,
     train: bool,
     rng: Optional[jax.Array],
+    with_activity: bool = False,
 ):
     """decoder_step twin with distributed attention; everything after the
-    attend runs replicated (same values on every context shard)."""
+    attend runs replicated (same values on every context shard).
+
+    with_activity (static) appends the step's L1 activity partials
+    (ctx_sharded, model_replicated) to the return tuple."""
     if train:
         k_att, k_in, k_out, k_state, k_dec = jax.random.split(rng, 5)
     else:
         k_att = k_in = k_out = k_state = k_dec = None
     ldr = config.lstm_drop_rate
+    act_ctx = act_rep = jnp.float32(0)
 
-    context, alpha_local = _cp_attend(
-        params, config, ctx_local, state.output, train, k_att
+    attended = _cp_attend(
+        params, config, ctx_local, state.output, train, k_att,
+        with_activity=with_activity,
     )
+    if with_activity:
+        context, alpha_local, (act_ctx, act_rep) = attended
+    else:
+        context, alpha_local = attended
     word_embed = params["word_embedding"]["weights"][word]
 
     lstm_input = jnp.concatenate([context, word_embed], axis=-1)
@@ -142,8 +162,13 @@ def _cp_decoder_step(
     recurrent_h = _dropout(k_state, new_h, ldr, train)
 
     expanded = jnp.concatenate([emitted, context, word_embed], axis=-1)
-    logits = decode_logits(params, config, expanded, train, k_dec)
+    logits = decode_logits(
+        params, config, expanded, train, k_dec, with_activity=with_activity
+    )
     new_state = DecoderState(memory=new_c, output=emitted, recurrent=recurrent_h)
+    if with_activity:
+        logits, dec_act = logits  # decode temp is model-replicated
+        return new_state, logits, alpha_local, (act_ctx, act_rep + dec_act)
     return new_state, logits, alpha_local
 
 
@@ -170,11 +195,20 @@ def _cp_loss_body(
     rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
     k_init, k_steps = jax.random.split(rng)
 
+    # fc L1 activity regularization rides the same static-flag path as
+    # teacher_forced_decode (reference utils/nn.py:40-43; gate is train)
+    with_activity = train and config.fc_activity_regularizer_scale > 0
+
     # init from the GLOBAL mean context: local partial mean + psum
     n_local = ctx_local.shape[1]
     cp = jax.lax.psum(1, AXIS)
     context_mean = jax.lax.psum(ctx_local.mean(axis=1) / cp, AXIS)
-    state = _cp_init_state(params, config, context_mean, train, k_init)
+    state = _cp_init_state(
+        params, config, context_mean, train, k_init, with_activity=with_activity
+    )
+    init_act = jnp.float32(0)
+    if with_activity:
+        state, init_act = state
 
     words_in = jnp.concatenate(
         [jnp.zeros((B, 1), sentences.dtype), sentences[:, :-1]], axis=1
@@ -183,9 +217,14 @@ def _cp_loss_body(
 
     def body(state, xs):
         word_t, rng_t = xs
-        state, logits, alpha_local = _cp_decoder_step(
-            params, config, ctx_local, state, word_t, train, rng_t
+        out = _cp_decoder_step(
+            params, config, ctx_local, state, word_t, train, rng_t,
+            with_activity=with_activity,
         )
+        if with_activity:
+            state, logits, alpha_local, acts = out
+            return state, (logits, alpha_local, acts)
+        state, logits, alpha_local = out
         return state, (logits, alpha_local)
 
     if train and config.remat_decoder:
@@ -198,7 +237,18 @@ def _cp_loss_body(
             prevent_cse=False,
         )
 
-    _, (logits, alphas_local) = jax.lax.scan(body, state, (words_in.T, step_rngs))
+    _, ys = jax.lax.scan(body, state, (words_in.T, step_rngs))
+    if with_activity:
+        logits, alphas_local, (acts_ctx, acts_rep) = ys
+        # ctx-sharded partials (t1) sum over BOTH axes; model-replicated
+        # ones (t2 / decode temp / init MLP) over 'data' only — summing a
+        # replicated value over AXIS would multiply it by the CP degree
+        fc_activity = jax.lax.psum(
+            jax.lax.psum(acts_ctx.sum(), AXIS) + acts_rep.sum() + init_act,
+            "data",
+        )
+    else:
+        logits, alphas_local = ys
     logits = logits.transpose(1, 0, 2)           # [B, T, V]
     alphas_local = alphas_local.transpose(1, 0, 2)  # [B, T, Nl]
 
@@ -231,15 +281,22 @@ def _cp_loss_body(
         "attention_loss": attention_loss,
         "accuracy": accuracy,
     }
+    if with_activity:
+        # scale applied by the caller, into the same reg bucket the
+        # reference sums via tf.losses.get_regularization_loss()
+        metrics["fc_activity"] = fc_activity
     return total, metrics
 
 
-def _cp_init_state(params, config, context_mean, train, rng):
+def _cp_init_state(params, config, context_mean, train, rng, with_activity=False):
     """init_state from an already-reduced global context mean (the mean is
-    computed with a psum outside; the MLP itself is replicated)."""
+    computed with a psum outside; the MLP itself is replicated).
+
+    with_activity (static) returns (state, model-replicated L1 partial)."""
     p = params["initialize"]
     rate = config.fc_drop_rate
     dt = jnp.dtype(config.compute_dtype)
+    act = jnp.float32(0)
     if train:
         k0, k1, k2 = jax.random.split(rng, 3)
         context_mean = _dropout(k0, context_mean, rate, train)
@@ -249,12 +306,14 @@ def _cp_init_state(params, config, context_mean, train, rng):
     else:
         ta = _dense(p["fc_a1"], context_mean, activation="tanh", dtype=dt)
         tb = _dense(p["fc_b1"], context_mean, activation="tanh", dtype=dt)
+        act = _l1(ta) + _l1(tb)
         if train:
             ta = _dropout(k1, ta, rate, train)
             tb = _dropout(k2, tb, rate, train)
         memory = _dense(p["fc_a2"], ta, dtype=dt)
         output = _dense(p["fc_b2"], tb, dtype=dt)
-    return DecoderState(memory=memory, output=output, recurrent=output)
+    state = DecoderState(memory=memory, output=output, recurrent=output)
+    return (state, act) if with_activity else state
 
 
 def make_context_parallel_loss(config: Config, mesh: Mesh, train: bool = True):
@@ -295,9 +354,14 @@ def make_context_parallel_train_step(config: Config, mesh: Mesh):
             variables: Dict[str, Any] = {"params": params}
             if state.batch_stats:
                 variables["batch_stats"] = state.batch_stats
-            contexts, enc_state = encode(
-                variables, config, batch["images"], config.train_cnn
+            conv_act_scale = (
+                config.conv_activity_regularizer_scale if config.train_cnn else 0.0
             )
+            contexts, enc_state = encode(
+                variables, config, batch["images"], config.train_cnn,
+                collect_activity=conv_act_scale > 0,
+            )
+            conv_activity = enc_state.pop("activity_l1", jnp.float32(0))
             core, metrics = cp_loss(
                 params["decoder"],
                 contexts,
@@ -305,14 +369,21 @@ def make_context_parallel_train_step(config: Config, mesh: Mesh):
                 batch["masks"],
                 rng,
             )
+            metrics = dict(metrics)
             reg = regularization_loss(
                 params,
                 fc_scale=config.fc_kernel_regularizer_scale,
                 conv_scale=config.conv_kernel_regularizer_scale,
                 train_cnn=config.train_cnn,
             )
+            # activity terms join the reg bucket (compute_loss parity)
+            reg = (
+                reg
+                + config.fc_activity_regularizer_scale
+                * metrics.pop("fc_activity", jnp.float32(0))
+                + conv_act_scale * conv_activity
+            )
             total = core + reg
-            metrics = dict(metrics)
             metrics["reg_loss"] = reg
             metrics["total_loss"] = total
             return total, (metrics, enc_state)

@@ -39,6 +39,7 @@ from .train.checkpoint import (
 )
 from .train.step import TrainState, create_train_state, make_jit_train_step
 from .utils.fileio import atomic_write
+from .utils.progress import Progress, track
 from .utils.summary import SummaryWriter
 
 
@@ -168,6 +169,13 @@ def train(
     stopped = False
     with SummaryWriter(config.summary_dir) as writer:
         for epoch in range(start_epoch, config.num_epochs):
+            # per-batch visibility, tqdm-style (reference base_model.py:49-50);
+            # metric-free so the async dispatch chain never syncs for it
+            bar = Progress(
+                dataset.num_batches,
+                desc=f"epoch {epoch + 1}/{config.num_epochs}",
+                initial=skip_batches if epoch == start_epoch else 0,
+            )
             for batch in loader:
                 if config.max_steps and step >= config.max_steps:
                     stopped = True
@@ -208,6 +216,8 @@ def train(
                     writer.variable_stats(step, state.params)
                 if config.save_period and step % config.save_period == 0:
                     save_checkpoint(state, config)
+                bar.update()
+            bar.close()
             if stopped:
                 break
             print(f"epoch {epoch + 1}/{config.num_epochs} done (step {int(state.step)})")
@@ -300,7 +310,9 @@ def decode_dataset(
             from .utils.dist import gather_tree_replicated
 
             gathered = []
-            for batch in loader:
+            for batch in track(
+                loader, local_ds.num_batches, desc="decode(mesh)"
+            ):
                 out = run_batch(batch)
                 # assembly only consumes beam 0: slice on device, then one
                 # batched cross-host gather for the whole tuple
@@ -382,7 +394,9 @@ def decode_dataset(
                 row["alphas"] = alphas[i, :length]    # [len, N]
             results.append(row)
 
-    for batch in loader:
+    # per-batch visibility during decode (reference base_model.py:82,131
+    # tqdm-bars eval/test; a full-COCO eval would otherwise run silent)
+    for batch in track(loader, dataset.num_batches, desc="decode"):
         out = run_batch(batch)                     # async dispatch
         if prev is not None:
             drain(*prev)

@@ -224,3 +224,43 @@ def test_cp_remat_matches_baseline(rng):
         ),
         g0, g1,
     )
+
+
+def test_cp_fc_activity_matches_single_device(rng):
+    """The CP decoder's fc L1 activity term (psum-assembled from context-
+    sharded t1 partials + model-replicated t2/decode/init partials) must
+    equal the single-device sum.  Dropout rates 0 make train-mode math
+    deterministic, so the comparison is exact up to reduction order."""
+    s = 1e-3
+    kw = dict(
+        num_attend_layers=2, mesh_shape=(2, 4),
+        fc_drop_rate=0.0, lstm_drop_rate=0.0,
+    )
+    config = _cfg(fc_activity_regularizer_scale=s, **kw)
+    mesh = make_mesh(config)
+    params = init_decoder_params(jax.random.PRNGKey(0), config)
+
+    B, T = 4, config.max_caption_length
+    N, D = config.num_ctx, config.dim_ctx
+    contexts = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+    sentences = jnp.asarray(
+        rng.integers(0, config.vocabulary_size, size=(B, T)).astype(np.int32)
+    )
+    masks = jnp.ones((B, T), jnp.float32)
+    key = jax.random.PRNGKey(1)
+
+    cp_loss = make_context_parallel_loss(config, mesh, train=True)
+    _, metrics_cp = cp_loss(params, contexts, sentences, masks, key)
+    assert "fc_activity" in metrics_cp
+
+    # single-device activity via the loss's linearity in the scale
+    batch = {"contexts": contexts, "word_idxs": sentences, "masks": masks}
+    variables = {"params": {"cnn": {}, "decoder": params}}
+    total_s, _ = compute_loss(variables, config, batch, rng=key, train=True)
+    total_0, _ = compute_loss(
+        variables, _cfg(fc_activity_regularizer_scale=0.0, **kw),
+        batch, rng=key, train=True,
+    )
+    want = (float(total_s) - float(total_0)) / s
+    assert want > 0
+    np.testing.assert_allclose(float(metrics_cp["fc_activity"]), want, rtol=1e-4)

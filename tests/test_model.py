@@ -341,3 +341,78 @@ class TestRematDecoder:
                 ),
                 g0["params"]["cnn"], g1["params"]["cnn"],
             )
+
+
+class TestActivityRegularization:
+    """L1 activity regularization (reference utils/nn.py:23-26,40-43):
+    scale·Σ|output| over *activated* layer outputs — tanh fc layers when
+    training, relu convs only when the CNN trains.  The loss is linear in
+    each scale with the activity sum as slope, which the tests exploit to
+    verify the term without duplicating the forward math."""
+
+    def _loss(self, cfg, batch, key):
+        variables = init_variables(jax.random.PRNGKey(0), cfg)
+        total, _ = compute_loss(variables, cfg, batch, rng=key, train=True)
+        return float(total)
+
+    def test_fc_activity_linear_in_scale(self):
+        key = jax.random.PRNGKey(7)
+        losses = {}
+        for s in (0.0, 1e-4, 2e-4):
+            cfg = tiny_config(fc_activity_regularizer_scale=s)
+            losses[s] = self._loss(cfg, tiny_contexts_batch(cfg), key)
+        slope = (losses[1e-4] - losses[0.0]) / 1e-4
+        assert slope > 0, "tanh activity sum must be positive"
+        np.testing.assert_allclose(
+            losses[2e-4] - losses[0.0], 2 * (losses[1e-4] - losses[0.0]), rtol=1e-4
+        )
+
+    def test_fc_activity_zero_without_activated_layers(self):
+        # 1-layer init/attend/decode variants use activation=None everywhere
+        # (reference model.py:362-371,402-415,442-446): nothing collects
+        key = jax.random.PRNGKey(7)
+        losses = []
+        for s in (0.0, 1e-3):
+            cfg = tiny_config(
+                fc_activity_regularizer_scale=s,
+                num_initialize_layers=1,
+                num_attend_layers=1,
+                num_decode_layers=1,
+            )
+            losses.append(self._loss(cfg, tiny_contexts_batch(cfg), key))
+        np.testing.assert_allclose(losses[0], losses[1], rtol=1e-7)
+
+    def test_conv_activity_vgg16_linear_resnet_zero_frozen_off(self):
+        key = jax.random.PRNGKey(3)
+
+        def loss(cnn, s, train_cnn=True):
+            cfg = tiny_config(
+                cnn=cnn, image_size=32, train_cnn=train_cnn,
+                conv_activity_regularizer_scale=s,
+            )
+            B, T = cfg.batch_size, cfg.max_caption_length
+            rng = np.random.default_rng(0)  # same batch for every scale
+            batch = {
+                "images": jnp.asarray(
+                    rng.normal(size=(B, 32, 32, 3)), jnp.float32
+                ),
+                "word_idxs": jnp.asarray(
+                    np.arange(B * T).reshape(B, T) % cfg.vocabulary_size, jnp.int32
+                ),
+                "masks": jnp.ones((B, T), jnp.float32),
+            }
+            variables = init_variables(jax.random.PRNGKey(0), cfg)
+            total, _ = compute_loss(variables, cfg, batch, rng=key, train=True)
+            return float(total)
+
+        # VGG16: 13 relu convs collect; linear in the scale
+        l0, l1, l2 = (loss("vgg16", s) for s in (0.0, 1e-6, 2e-6))
+        assert l1 > l0
+        np.testing.assert_allclose(l2 - l0, 2 * (l1 - l0), rtol=1e-3)
+        # ResNet50: every conv passes activation=None (relu applied after
+        # BN, reference model.py:70-81,111-188) — no activity anywhere
+        r0, r1 = (loss("resnet50", s) for s in (0.0, 1e-3))
+        np.testing.assert_allclose(r0, r1, rtol=1e-7)
+        # frozen CNN: the conv activity gate is train_cnn (utils/nn.py:23)
+        f0, f1 = (loss("vgg16", s, train_cnn=False) for s in (0.0, 1e-3))
+        np.testing.assert_allclose(f0, f1, rtol=1e-7)

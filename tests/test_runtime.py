@@ -518,3 +518,63 @@ def test_cli_print_config(capsys):
     cfg = json.loads(capsys.readouterr().out)
     assert cfg["batch_size"] == 11
     assert cfg["cnn"] == "vgg16"
+
+
+class TestProgress:
+    """Per-batch progress reporting (reference tqdm parity,
+    base_model.py:49-50,82,131)."""
+
+    def test_non_tty_prints_every_n_and_final(self):
+        import io
+
+        from sat_tpu.utils.progress import Progress
+
+        out = io.StringIO()  # StringIO.isatty() is False
+        with Progress(10, desc="epoch 1/3", stream=out, every=4) as bar:
+            for _ in range(10):
+                bar.update()
+        lines = out.getvalue().strip().splitlines()
+        assert lines[0].startswith("epoch 1/3: 4/10")
+        assert lines[1].startswith("epoch 1/3: 8/10")
+        assert lines[-1].startswith("epoch 1/3: 10/10")
+        assert len(lines) == 3  # no duplicate final line, no spam
+
+    def test_non_tty_no_duplicate_when_total_on_cadence(self):
+        import io
+
+        from sat_tpu.utils.progress import Progress
+
+        out = io.StringIO()
+        with Progress(8, stream=out, every=4) as bar:
+            for _ in range(8):
+                bar.update()
+        lines = out.getvalue().strip().splitlines()
+        assert len(lines) == 2  # 4/8 and 8/8 — close() adds nothing
+
+    def test_tty_redraws_one_line(self):
+        import io
+
+        from sat_tpu.utils.progress import Progress
+
+        class Tty(io.StringIO):
+            def isatty(self):
+                return True
+
+        out = Tty()
+        with Progress(5, desc="d", stream=out, min_interval_s=0.0) as bar:
+            for _ in range(5):
+                bar.update()
+        v = out.getvalue()
+        assert v.count("\r") == 6  # 5 redraws + final
+        assert v.endswith("d: 5/5 " + v[v.rindex("["):])  # final line present
+        assert "\n" in v  # close() terminates the bar line
+
+    def test_track_wraps_iterables(self):
+        import io
+
+        from sat_tpu.utils.progress import track
+
+        out = io.StringIO()
+        seen = list(track(range(6), 6, desc="t", stream=out, every=2))
+        assert seen == list(range(6))
+        assert "t: 6/6" in out.getvalue()
