@@ -398,3 +398,88 @@ def _import_cnn_nested(
     if batch_stats:
         new_variables["batch_stats"] = batch_stats
     return new_variables, count
+
+
+# ---------------------------------------------------------------------------
+# reference-checkpoint EXPORT (migration in the other direction)
+# ---------------------------------------------------------------------------
+
+_BN_EXPORT_NAMES = {
+    "scale": "gamma", "bias": "beta", "mean": "moving_mean", "var": "moving_variance",
+}
+
+
+def _export_cnn_tree(tree: Any, out: Dict[str, np.ndarray]) -> None:
+    """Walk a CNN param/batch-stat tree emitting reference TF-scope names:
+    a node holding our Conv wrapper's inner 'conv' module becomes
+    ``<op>/{kernel,bias}``; a node of BN leaves becomes
+    ``<op>/{gamma,beta}`` (params) / ``<op>/{moving_mean,moving_variance}``
+    (stats); anything else (res2a block containers) recurses."""
+    if not isinstance(tree, dict):
+        return
+    for op, sub in tree.items():
+        if not isinstance(sub, dict):
+            continue
+        inner = sub.get("conv")
+        if isinstance(inner, dict) and "kernel" in inner:
+            for leaf, arr in inner.items():
+                out[f"{op}/{leaf}:0"] = np.asarray(arr)
+        elif any(k in sub and not isinstance(sub[k], dict) for k in _BN_EXPORT_NAMES):
+            for leaf, arr in sub.items():
+                if leaf in _BN_EXPORT_NAMES and not isinstance(arr, dict):
+                    out[f"{op}/{_BN_EXPORT_NAMES[leaf]}:0"] = np.asarray(arr)
+        else:
+            _export_cnn_tree(sub, out)
+
+
+def export_reference_checkpoint(state: Any, path: str) -> int:
+    """Inverse of :func:`import_reference_checkpoint`: write the
+    reference's flat ``{var.name: value}`` npy (base_model.py:242-249), so
+    a sat_tpu-trained model migrates BACK into the reference (its load()
+    assigns by var name with missing-key tolerance, base_model.py:270-277)
+    — and so the import path can be proven end-to-end offline by
+    round-tripping a real trained state (RESULTS.md import-finetune run).
+
+    Same name conventions the import consumes: decoder scopes verbatim
+    (``word_embedding/weights:0``, ``attend/fc_1a/kernel:0``, …), the TF1
+    LSTMCell under ``lstm/lstm_cell/`` with its concatenated (i,j,f,o)
+    kernel unchanged, conv kernels HWIO as stored, BN as
+    gamma/beta/moving_mean/moving_variance.  Optimizer slots are not
+    exported (our optax state has no meaning to the reference's Adam).
+    Returns the tensor count written."""
+    # Mesh-sharded states (single- or multi-process): gather shards held
+    # by other hosts first, then one batched D2H transfer — the same
+    # discipline as state_to_flat; per-leaf np.asarray would crash on
+    # non-addressable arrays and pay one transfer per tensor.
+    gathered = jax.device_get(
+        gather_tree_replicated(
+            {"params": state.params, "batch_stats": state.batch_stats or {}}
+        )
+    )
+    state = state._replace(
+        params=gathered["params"], batch_stats=gathered["batch_stats"]
+    )
+    flat: Dict[str, np.ndarray] = {}
+    dec = state.params.get("decoder", {})
+    for scope, sub in dec.items():
+        if scope == "lstm":
+            for leaf, arr in sub.items():
+                flat[f"lstm/lstm_cell/{leaf}:0"] = np.asarray(arr)
+            continue
+        for name, node in sub.items():
+            if isinstance(node, dict):
+                for leaf, arr in node.items():
+                    flat[f"{scope}/{name}/{leaf}:0"] = np.asarray(arr)
+            else:
+                flat[f"{scope}/{name}:0"] = np.asarray(node)
+
+    _export_cnn_tree(state.params.get("cnn", {}), flat)
+    if getattr(state, "batch_stats", None):
+        _export_cnn_tree(state.batch_stats, flat)
+
+    flat["global_step:0"] = np.asarray(int(state.step), np.int64)
+    atomic_write(
+        path, "wb",
+        lambda f: np.save(f, np.array(flat, dtype=object), allow_pickle=True),
+    )
+    return len(flat) - 1  # global_step is bookkeeping, not a tensor

@@ -336,3 +336,47 @@ def test_stale_config_pointer_does_not_shadow_newer_checkpoint(tmp_path, rng):
     save_checkpoint(state, config)                     # 1.npz + pointer→1
     config.replace(global_step=0).save(str(tmp_path / "config.json"))  # stale
     assert latest_checkpoint(str(tmp_path)).endswith("1.npz")
+
+
+@pytest.mark.parametrize("cnn", ["vgg16", "resnet50"])
+def test_export_import_reference_roundtrip(tmp_path, cnn):
+    """export_reference_checkpoint is the exact inverse of
+    import_reference_checkpoint: a state exported to the reference's flat
+    TF1 layout and imported into a differently-seeded fresh state must
+    reproduce every param (and BN stat) bit-for-bit — the migration path
+    in both directions, proven on real trees of both encoder families."""
+    from sat_tpu.train.checkpoint import (
+        export_reference_checkpoint,
+        import_reference_checkpoint,
+    )
+
+    config = _tiny_config(cnn=cnn, train_cnn=True)
+    src = create_train_state(jax.random.PRNGKey(0), config)
+    path = str(tmp_path / "ref_export.npy")
+    n_written = export_reference_checkpoint(src, path)
+
+    # every param leaf + every BN stat leaf must have been exported
+    n_leaves = len(jax.tree_util.tree_leaves(src.params)) + len(
+        jax.tree_util.tree_leaves(src.batch_stats)
+    )
+    assert n_written == n_leaves
+
+    dst = create_train_state(jax.random.PRNGKey(7), config)
+    before = jax.tree_util.tree_leaves(dst.params)
+    after_src = jax.tree_util.tree_leaves(src.params)
+    assert any(
+        not np.array_equal(a, b) for a, b in zip(before, after_src)
+    ), "seeds produced identical params; test is vacuous"
+
+    imported, n_loaded = import_reference_checkpoint(dst, path)
+    assert n_loaded == n_written
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(src.params)[0],
+        jax.tree_util.tree_flatten_with_path(imported.params)[0],
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(pa))
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(src.batch_stats)[0],
+        jax.tree_util.tree_flatten_with_path(imported.batch_stats)[0],
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(pa))
