@@ -32,9 +32,26 @@ ILSVRC_2012_MEAN = np.array([104.00698793, 116.66876762, 122.67891434], np.float
 
 
 class ImageLoader:
-    def __init__(self, mean: Optional[np.ndarray] = None, size: int = 224):
+    """raw=True defers the astype(float32)−mean step to the accelerator
+    (models.captioner.encode mean-subtracts uint8 inputs on device):
+    numerically IDENTICAL — the resize already happens on the uint8 image,
+    mean-sub is the final op either way — but the host skips a float32
+    allocation per image and the host→device feed shrinks 4×.  The config
+    knob is ``device_preprocess`` (on by default)."""
+
+    def __init__(
+        self, mean: Optional[np.ndarray] = None, size: int = 224,
+        raw: bool = False,
+    ):
+        if raw and mean is not None:
+            raise ValueError(
+                "raw=True defers mean subtraction to the device, which "
+                "hardcodes ILSVRC_2012_MEAN (captioner.encode) — a custom "
+                "mean would be silently ignored; use raw=False with it"
+            )
         self.mean = ILSVRC_2012_MEAN if mean is None else np.asarray(mean, np.float32)
         self.size = size
+        self.raw = raw
 
     def load_image(self, image_file: str) -> np.ndarray:
         import cv2
@@ -44,6 +61,8 @@ class ImageLoader:
             raise FileNotFoundError(f"cannot decode image: {image_file}")
         image = image[:, :, ::-1]  # BGR → RGB
         image = cv2.resize(image, (self.size, self.size))
+        if self.raw:
+            return np.ascontiguousarray(image)  # uint8 RGB, device finishes
         return image.astype(np.float32) - self.mean
 
     def load_images(self, image_files: Sequence[str]) -> np.ndarray:
@@ -54,8 +73,10 @@ class PrefetchLoader:
     """Wraps a batch iterator; decodes images in a thread pool and keeps a
     bounded queue of ready batches so the accelerator never waits on cv2.
 
-    Yields dicts with 'images' [B,224,224,3] float32 plus any extra arrays
-    the source iterator produced ('word_idxs', 'masks', 'files')."""
+    Yields dicts with 'images' [B,224,224,3] — float32 mean-subtracted, or
+    uint8 RGB when the loader runs raw=True (device finishes the
+    preprocessing; see ImageLoader) — plus any extra arrays the source
+    iterator produced ('word_idxs', 'masks', 'files')."""
 
     def __init__(
         self,

@@ -70,6 +70,15 @@ def encode(
     collection the Conv layers sow (Σ|relu output| per activated conv —
     VGG16 only; ResNet convs pass activation=None like the reference,
     utils/nn.py:55-57) into new_state['activity_l1']."""
+    if images.dtype == jnp.uint8:
+        # device-side preprocessing tail (ImageLoader raw=True feed): the
+        # host already decoded/BGR→RGB/resized in uint8; the final
+        # astype(float32) − ILSVRC mean runs here instead — bitwise equal
+        # to the host path (reference utils/misc.py:22-27 order), 4× less
+        # host→device traffic
+        from ..data.images import ILSVRC_2012_MEAN
+
+        images = images.astype(jnp.float32) - jnp.asarray(ILSVRC_2012_MEAN)
     encoder = make_encoder(config)
     cnn_vars: Dict[str, Any] = {"params": variables["params"]["cnn"]}
     if "batch_stats" in variables:

@@ -141,7 +141,7 @@ def train(
         place_batch = lambda b: b  # noqa: E731
     loader = PrefetchLoader(
         dataset,
-        ImageLoader(size=config.image_size),
+        ImageLoader(size=config.image_size, raw=config.device_preprocess),
         num_workers=config.num_data_workers,
         prefetch_depth=config.prefetch_depth,
     )
@@ -308,7 +308,7 @@ def decode_dataset(
             local_ds = process_local_dataset(padded)
             loader = PrefetchLoader(
                 local_ds,
-                ImageLoader(size=config.image_size),
+                ImageLoader(size=config.image_size, raw=config.device_preprocess),
                 num_workers=config.num_data_workers,
                 prefetch_depth=config.prefetch_depth,
             )
@@ -355,7 +355,7 @@ def decode_dataset(
 
     loader = PrefetchLoader(
         dataset,
-        ImageLoader(size=config.image_size),
+        ImageLoader(size=config.image_size, raw=config.device_preprocess),
         num_workers=config.num_data_workers,
         prefetch_depth=config.prefetch_depth,
     )

@@ -256,6 +256,11 @@ def main() -> int:
         help="also decode greedily (beam=1) and record the beam-3 deltas",
     )
     ap.add_argument(
+        "--corpus-only", action="store_true",
+        help="generate the fixture corpus under --out and exit (for runs "
+        "that only need the inputs, e.g. the profiler stage)",
+    )
+    ap.add_argument(
         "--image-size", type=int, default=224,
         help="input edge; 224 = flagship, smaller for CPU runs",
     )
@@ -296,6 +301,8 @@ def main() -> int:
     else:
         img_dir, caption_file = make_corpus(root, num_images=args.num_images)
     print(f"[quality +{time.time()-t0:5.1f}s] corpus: {args.num_images} images at {img_dir}")
+    if args.corpus_only:
+        return 0
 
     from sat_tpu.cli import build_config
 

@@ -35,13 +35,15 @@ if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_joint.json" ]; then
   echo "STAGE FAILED: bench joint (rc=$rc)"; FAILED="$FAILED bench_joint"
 fi
 
-echo "=== stage 1b: eval decode throughput (beam=3) ==="
-timeout 500 python scripts/bench_eval.py 2>"$OUT/bench_eval.log" \
-  | tee "$OUT/bench_eval.json"
-rc=${PIPESTATUS[0]}
-if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_eval.json" ]; then
-  echo "STAGE FAILED: bench_eval (rc=$rc)"; FAILED="$FAILED bench_eval"
-fi
+echo "=== stage 1b: eval decode throughput (beam=3, B=32 and B=64) ==="
+for EB in 32 64; do
+  timeout 500 python scripts/bench_eval.py --batch $EB \
+    2>"$OUT/bench_eval_B$EB.log" | tee "$OUT/bench_eval_B$EB.json"
+  rc=${PIPESTATUS[0]}
+  if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_eval_B$EB.json" ]; then
+    echo "STAGE FAILED: bench_eval B=$EB (rc=$rc)"; FAILED="$FAILED bench_eval_B$EB"
+  fi
+done
 
 echo "=== stage 1c: A/B knobs (dropout PRNG, decoder/encoder remat, resnet50) ==="
 for label in "rng_threefry BENCH_RNG_IMPL=threefry2x32" \
@@ -63,11 +65,57 @@ timeout 500 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
 rc=${PIPESTATUS[0]}
 [ "$rc" -ne 0 ] && { echo "STAGE FAILED: pallas (rc=$rc)"; FAILED="$FAILED pallas"; }
 
+echo "=== stage 2b: jax.profiler trace of the train hot loop ==="
+# one real trace backing the step-time/PrefetchLoader claims (r1 ask #8)
+timeout 300 python scripts/quality_run.py --corpus-only --out "$OUT/profile_run" \
+  >"$OUT/profile_corpus.log" 2>&1
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "STAGE FAILED: profile corpus gen (rc=$rc) — see $OUT/profile_corpus.log"
+  FAILED="$FAILED profile"
+else
+  PROF="$OUT/profile_run_trace"
+  timeout 900 python -m sat_tpu.cli --phase=train \
+    --set train_image_dir="$OUT/profile_run/images" \
+    --set train_caption_file="$OUT/profile_run/captions.json" \
+    --set vocabulary_file="$OUT/profile_run/vocabulary_basic.csv" \
+    --set temp_annotation_file="$OUT/profile_run/anns_basic.csv" \
+    --set temp_data_file="$OUT/profile_run/data_basic.npy" \
+    --set save_dir="$OUT/profile_run/models2" \
+    --set summary_dir="$OUT/profile_run/summary2" \
+    --set max_train_ann_num=none --set batch_size=32 --set num_epochs=30 \
+    --set max_steps=25 --set save_period=0 \
+    --set profile_dir="$PROF" --set profile_start_step=8 \
+    --set profile_num_steps=5 >"$OUT/profile_train.log" 2>&1
+  rc=$?
+  # a COMPLETE trace only: partial dirs from a mid-trace kill don't count
+  if [ "$rc" -eq 0 ] && { ls "$PROF"/plugins/profile/*/*.xplane.pb >/dev/null 2>&1 || \
+       ls "$PROF"/plugins/profile/*/*.trace.json.gz >/dev/null 2>&1; }; then
+    echo "profiler trace captured under $PROF"
+  else
+    echo "STAGE FAILED: profiler trace (rc=$rc) — see $OUT/profile_train.log"
+    FAILED="$FAILED profile"
+  fi
+fi
+
 echo "=== stage 3: flagship quality run ==="
 timeout 1200 python scripts/quality_run.py --steps 300 \
   2>&1 | tee "$OUT/quality.txt" | tail -20
 rc=${PIPESTATUS[0]}
 [ "$rc" -ne 0 ] && { echo "STAGE FAILED: quality run (rc=$rc)"; FAILED="$FAILED quality"; }
+
+echo "=== stage 4 (optional, TPU_SESSION_RICH=1): rich-corpus quality + import-finetune ==="
+if [ "${TPU_SESSION_RICH:-0}" = "1" ]; then
+  timeout 3600 python scripts/quality_run.py --corpus rich --frozen-cnn \
+    --image-size 64 --batch-size 16 --steps 4000 --beam-compare \
+    --out runs/quality_rich 2>&1 | tee "$OUT/quality_rich.txt" | tail -15
+  rc=${PIPESTATUS[0]}
+  [ "$rc" -ne 0 ] && { echo "STAGE FAILED: rich quality (rc=$rc)"; FAILED="$FAILED quality_rich"; }
+  timeout 1800 python scripts/import_finetune_run.py 2>&1 \
+    | tee "$OUT/import_ft.txt" | tail -8
+  rc=${PIPESTATUS[0]}
+  [ "$rc" -ne 0 ] && { echo "STAGE FAILED: import-finetune (rc=$rc)"; FAILED="$FAILED import_ft"; }
+fi
 
 if [ -n "$FAILED" ]; then
   echo "=== session finished with FAILED stages:$FAILED — artifacts in $OUT ==="

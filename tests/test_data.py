@@ -303,3 +303,52 @@ def test_prefetch_loader_abandoned_iterator_releases_producer(coco_fixture):
     while threading.active_count() > before and time.time() < deadline:
         time.sleep(0.05)
     assert threading.active_count() <= before
+
+
+class TestDevicePreprocess:
+    """uint8 raw feed + on-device mean-sub (config.device_preprocess) must
+    be bitwise-equal to the host path: the resize already runs on the
+    uint8 image in both modes (reference utils/misc.py:22-27 order), so
+    deferring astype(float32)−mean to the accelerator changes nothing
+    numerically while shrinking the feed 4x."""
+
+    def _jpg(self, tmp_path):
+        import cv2
+
+        rng = np.random.default_rng(0)
+        f = str(tmp_path / "img.jpg")
+        cv2.imwrite(f, rng.integers(0, 255, (48, 64, 3), dtype=np.uint8))
+        return f
+
+    def test_raw_loader_matches_host_preprocess(self, tmp_path):
+        from sat_tpu.data.images import ILSVRC_2012_MEAN, ImageLoader
+
+        f = self._jpg(tmp_path)
+        host = ImageLoader(size=32).load_image(f)
+        raw = ImageLoader(size=32, raw=True).load_image(f)
+        assert raw.dtype == np.uint8
+        np.testing.assert_array_equal(
+            host, raw.astype(np.float32) - ILSVRC_2012_MEAN
+        )
+
+    def test_encode_uint8_feed_bitwise_equals_float_feed(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from sat_tpu.config import Config
+        from sat_tpu.data.images import ILSVRC_2012_MEAN
+        from sat_tpu.models.captioner import encode, init_variables
+
+        cfg = Config(
+            image_size=32, vocabulary_size=30, dim_embedding=8,
+            num_lstm_units=8, dim_initialize_layer=8, dim_attend_layer=8,
+            dim_decode_layer=8, compute_dtype="float32",
+        )
+        variables = init_variables(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(1)
+        raw = rng.integers(0, 255, (2, 32, 32, 3), dtype=np.uint8)
+        host = raw.astype(np.float32) - ILSVRC_2012_MEAN
+
+        ctx_raw, _ = encode(variables, cfg, jnp.asarray(raw), train=False)
+        ctx_host, _ = encode(variables, cfg, jnp.asarray(host), train=False)
+        np.testing.assert_array_equal(np.asarray(ctx_raw), np.asarray(ctx_host))
