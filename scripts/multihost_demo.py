@@ -81,8 +81,16 @@ def main() -> int:
         compute_dtype="float32", num_epochs=1, save_period=0, log_every=1,
         mesh_shape=(args.procs, 1), batch_size=4, beam_size=2,
         num_data_workers=2, max_eval_ann_num=8,
+        # beam-0 alphas ride the cross-host gather; every host renders its
+        # interleaved slice of the panels (runtime._local_render_rows)
+        save_attention_maps=True,
     )
     config.save(os.path.join(args.root, "config.json"))
+    # a reused --root must not inflate the final panel-coverage check
+    import glob as _glob
+
+    for f in _glob.glob(os.path.join(config.eval_result_dir, "*_attention.jpg")):
+        os.remove(f)
 
     import re
     import threading
@@ -148,8 +156,22 @@ def main() -> int:
     if any(s != scores[0] for s in scores[1:]):
         print("FAIL: hosts disagree on eval scores")
         return 1
+
+    # the attention panels must cover every decoded image — each host
+    # rendered only its slice (runtime._local_render_rows), so full
+    # coverage proves the cross-host alpha gather AND the per-process
+    # render partition worked
+    import glob
+
+    results = json.load(open(config.eval_result_file))
+    panels = glob.glob(os.path.join(config.eval_result_dir, "*_attention.jpg"))
+    if len(panels) != len(results):
+        print(f"FAIL: {len(panels)} attention panels for {len(results)} "
+              "decoded images")
+        return 1
     print(f"MULTIHOST OK: {args.procs} processes, scores agree: "
-          f"Bleu_4={scores[0]['Bleu_4']:.3f}")
+          f"Bleu_4={scores[0]['Bleu_4']:.3f}; "
+          f"{len(panels)} attention panels rendered across hosts")
     return 0
 
 
