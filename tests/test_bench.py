@@ -49,3 +49,29 @@ def test_probe_round_trips_a_computation_on_cpu():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "probe ok" in proc.stderr
+
+
+def test_orchestrator_reports_deterministic_child_failure_as_bench_failed():
+    # A healthy probe followed by a bench child that crashes fast (bogus
+    # BENCH_CNN -> Config validation error) must NOT be retried until the
+    # budget burns and then mislabeled device_unreachable: after two fast
+    # failures the orchestrator emits bench_failed with the child's rc.
+    env = dict(
+        os.environ,
+        BENCH_CPU="1",
+        JAX_PLATFORMS="cpu",
+        BENCH_CNN="bogus_cnn",
+        BENCH_WATCHDOG_S="300",
+    )
+    proc = subprocess.run(
+        [sys.executable, BENCH],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=280,
+    )
+    assert proc.returncode == 4, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip().startswith("{")]
+    parsed = json.loads(lines[-1])
+    assert parsed["error"] == "bench_failed"
+    assert parsed["child_rc"] not in (None, 0)
