@@ -279,11 +279,17 @@ class TestRngImpl:
 
 
 class TestRematDecoder:
-    def test_remat_grads_match_baseline(self):
+    @pytest.mark.parametrize("act_scale", [0.0, 1e-4])
+    def test_remat_grads_match_baseline(self, act_scale):
         """config.remat_decoder recomputes the scan step in backward from
         the same per-step keys — loss and grads must match the
-        residual-stacking baseline to float tolerance."""
-        base = tiny_config(fc_drop_rate=0.3, lstm_drop_rate=0.2)
+        residual-stacking baseline to float tolerance.  Parametrized over
+        L1 activity regularization since with_activity changes the scan's
+        output structure under jax.checkpoint."""
+        base = tiny_config(
+            fc_drop_rate=0.3, lstm_drop_rate=0.2,
+            fc_activity_regularizer_scale=act_scale,
+        )
         remat = base.replace(remat_decoder=True)
         batch = tiny_contexts_batch(base)
         variables = init_variables(jax.random.PRNGKey(0), base)
