@@ -521,9 +521,16 @@ def main() -> int:
               "(--no-results-md)")
     else:
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        update_results_sections(
-            os.path.join(repo_root, "RESULTS.md"), main_text="\n".join(lines)
-        )
+        md = os.path.join(repo_root, "RESULTS.md")
+        if args.corpus == "rich":
+            # the rich run is supplementary evidence: it owns its marked
+            # section and must not replace the flagship main body
+            update_results_sections(
+                md, section="rich-corpus",
+                section_text="\n".join(lines[1:]),  # drop the H1
+            )
+        else:
+            update_results_sections(md, main_text="\n".join(lines))
         print(f"[quality +{time.time()-t0:5.1f}s] RESULTS.md written")
     for k, v in scores.items():
         print(f"  {k}: {v:.4f}")
