@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import sys
 from typing import List, Optional
 
@@ -174,6 +175,17 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     disarm = _arm_device_watchdog()
     import jax
+
+    # Honor JAX_PLATFORMS even when a sitecustomize force-registered a
+    # different PJRT plugin over it (observed in this environment: the
+    # env var alone loses the race and a JAX_PLATFORMS=cpu run still
+    # hangs inside a dead TPU tunnel's device init).
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass  # backend already initialized
 
     jax.devices()  # force backend init under the watchdog
     disarm()
