@@ -64,54 +64,32 @@ class BeamResult(NamedTuple):
     alphas: Optional[jnp.ndarray] = None
 
 
-def beam_search(
-    params,
+def run_search(
     config: Config,
-    contexts: jnp.ndarray,
+    step_fn,
+    state0: DecoderState,
+    B: int,
     eos_id: int,
     beam_size: Optional[int] = None,
     max_len: Optional[int] = None,
     valid_size: Optional[int] = None,
-    hoist_attention: bool = True,
     return_alphas: bool = False,
+    alpha_width: Optional[int] = None,
 ) -> BeamResult:
-    """Decode captions for a batch of context grids.
+    """The search engine shared by the single-device and context-parallel
+    decode paths.
 
-    contexts: [B, N, D] float32 (encoder output).
-    eos_id: vocabulary index of the '.' terminator token.
-    valid_size: number of real vocabulary entries; logit columns beyond it
-      are masked out.  The model's logit width is config.vocabulary_size,
-      but a vocabulary built from a small corpus shrinks below that
-      (reference vocabulary.py:25-26), leaving trailing logit columns with
-      no word — the reference would index past its word list there.
-    hoist_attention: precompute the context half of the attention MLP
-      outside the decode loop (inference-exact; False keeps the
-      step-by-step oracle path for testing).
-    return_alphas: also carry each hypothesis's per-step attention maps
-      through the search (the paper's per-word attention figures; neither
-      the reference nor its upstream exposes them at decode time).
+    step_fn(state, last_word [B*K] int32) -> (new_state, logits [B*K, V],
+    alpha [B*K, Na]) — one decoder step over the flattened beam batch.
+    state0: the per-image initial DecoderState already tiled to [B*K, H].
+    alpha_width: Na of step_fn's alpha (the LOCAL context-block width
+    under context parallelism); required when return_alphas is set.
     """
     K = beam_size or config.beam_size
     T = max_len or config.max_caption_length
-    B, N, D = contexts.shape
     V = config.vocabulary_size
-
-    # one shared context grid per image, flattened to a [B*K] step batch
-    ctx_tiled = jnp.broadcast_to(contexts[:, None], (B, K, N, D)).reshape(B * K, N, D)
-
-    # hoist the context half of the attention MLP out of the T×K loop
-    # (loop-invariant at inference; the reference recomputes it every step)
-    proj_tiled = None
-    if hoist_attention:
-        proj = precompute_attend(params, config, contexts)
-        proj_tiled = jnp.broadcast_to(
-            proj[:, None], (B, K) + proj.shape[1:]
-        ).reshape((B * K,) + proj.shape[1:])
-
-    state0 = init_state(params, config, contexts, train=False)  # [B, H]
-    H = state0.output.shape[-1]
-    tile = lambda x: jnp.broadcast_to(x[:, None], (B, K, H)).reshape(B * K, H)  # noqa: E731
-    state = DecoderState(*(tile(s) for s in state0))
+    state = state0
+    H = state.output.shape[-1]
 
     # beam 0 alive at logp 0; others dead so step 0 expands a single beam
     live_logp = jnp.full((B, K), NEG_INF, jnp.float32).at[:, 0].set(0.0)
@@ -125,7 +103,9 @@ def beam_search(
 
     # per-step attention maps of every hypothesis; zero-width unless
     # requested, so the carry copies cost nothing in the default path
-    An = N if return_alphas else 0
+    if return_alphas and alpha_width is None:
+        raise ValueError("return_alphas requires alpha_width")
+    An = (alpha_width or 0) if return_alphas else 0
     live_alphas = jnp.zeros((B, K, T, An), jnp.float32)
     fin_alphas = jnp.zeros((B, K, T, An), jnp.float32)
 
@@ -135,11 +115,8 @@ def beam_search(
         (state, live_logp, live_words, live_len, last_word,
          fin_logp, fin_words, fin_len, live_alphas, fin_alphas) = carry
 
-        new_state, logits, alpha = decoder_step(
-            params, config, ctx_tiled, state, last_word.reshape(B * K),
-            train=False, ctx_proj=proj_tiled,
-        )
-        step_alpha = alpha.reshape(B, K, N)[:, :, :An]          # [B,K,An]
+        new_state, logits, alpha = step_fn(state, last_word.reshape(B * K))
+        step_alpha = alpha.reshape(B, K, -1)[:, :, :An]          # [B,K,An]
         if valid_size is not None and valid_size < V:
             logits = logits.at[:, valid_size:].set(NEG_INF)
         step_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -220,6 +197,76 @@ def beam_search(
         log_scores=cand_logp[batch_idx, sel],
         lengths=cand_len[batch_idx, sel],
         alphas=alphas,
+    )
+
+
+def tile_beams(x: jnp.ndarray, K: int) -> jnp.ndarray:
+    """[B, ...] -> [B*K, ...] with each image's row repeated K times — the
+    shared per-image tensors (context grid, hoisted projection, initial
+    state) flattened to the search's [B*K] step batch."""
+    B = x.shape[0]
+    return jnp.broadcast_to(x[:, None], (B, K) + x.shape[1:]).reshape(
+        (B * K,) + x.shape[1:]
+    )
+
+
+def beam_search(
+    params,
+    config: Config,
+    contexts: jnp.ndarray,
+    eos_id: int,
+    beam_size: Optional[int] = None,
+    max_len: Optional[int] = None,
+    valid_size: Optional[int] = None,
+    hoist_attention: bool = True,
+    return_alphas: bool = False,
+) -> BeamResult:
+    """Decode captions for a batch of context grids.
+
+    contexts: [B, N, D] float32 (encoder output).
+    eos_id: vocabulary index of the '.' terminator token.
+    valid_size: number of real vocabulary entries; logit columns beyond it
+      are masked out.  The model's logit width is config.vocabulary_size,
+      but a vocabulary built from a small corpus shrinks below that
+      (reference vocabulary.py:25-26), leaving trailing logit columns with
+      no word — the reference would index past its word list there.
+    hoist_attention: precompute the context half of the attention MLP
+      outside the decode loop (inference-exact; False keeps the
+      step-by-step oracle path for testing).
+    return_alphas: also carry each hypothesis's per-step attention maps
+      through the search (the paper's per-word attention figures; neither
+      the reference nor its upstream exposes them at decode time).
+
+    The context-parallel twin of this wrapper (context grid sharded over
+    the mesh's 'model' axis, distributed-softmax attend) is
+    :func:`sat_tpu.parallel.context.cp_beam_search`; both plug their step
+    function into the same :func:`run_search` engine.
+    """
+    K = beam_size or config.beam_size
+    B, N, D = contexts.shape
+
+    # one shared context grid per image, flattened to a [B*K] step batch
+    ctx_tiled = tile_beams(contexts, K)
+
+    # hoist the context half of the attention MLP out of the T×K loop
+    # (loop-invariant at inference; the reference recomputes it every step)
+    proj_tiled = None
+    if hoist_attention:
+        proj_tiled = tile_beams(precompute_attend(params, config, contexts), K)
+
+    state0 = init_state(params, config, contexts, train=False)  # [B, H]
+    state0 = DecoderState(*(tile_beams(s, K) for s in state0))
+
+    def step_fn(state, last_word):
+        return decoder_step(
+            params, config, ctx_tiled, state, last_word,
+            train=False, ctx_proj=proj_tiled,
+        )
+
+    return run_search(
+        config, step_fn, state0, B, eos_id,
+        beam_size=K, max_len=max_len, valid_size=valid_size,
+        return_alphas=return_alphas, alpha_width=N,
     )
 
 

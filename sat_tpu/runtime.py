@@ -120,13 +120,12 @@ def train(
         if config.context_parallel > 1:
             # 'model' axis spent on the context grid (distributed-softmax
             # attention) instead of vocab TP; params stay replicated
-            from .parallel.context import make_context_parallel_train_step
+            from .parallel.context import (
+                make_context_parallel_train_step,
+                validate_cp_mesh,
+            )
 
-            if mesh.shape.get("model", 1) != config.context_parallel:
-                raise ValueError(
-                    f"context_parallel={config.context_parallel} requires "
-                    f"mesh 'model' axis of that size, got {dict(mesh.shape)}"
-                )
+            validate_cp_mesh(config, mesh)
             state = shard_train_state(
                 state, config.replace(vocabulary_size=-1), mesh
             )  # vocab rule disabled → fully replicated placement
@@ -280,18 +279,26 @@ def decode_dataset(
         #   alone;
         # * context-parallel runs trained with params REPLICATED
         #   (train() above, the 'model' axis was spent on the context
-        #   grid) — eval decodes under that same placement rather than
-        #   silently re-sharding to TP, which would surprise meshes where
-        #   vocabulary_size % model != 0.
-        placement_config = (
-            config.replace(vocabulary_size=-1)  # vocab rule off → replicated
-            if config.context_parallel > 1
-            else config
-        )
+        #   grid) — eval keeps that placement AND spends the 'model' axis
+        #   the same way: shard_map context-parallel beam search with the
+        #   grid sharded and the distributed-softmax attend
+        #   (parallel/context.py cp_beam_search).
+        if config.context_parallel > 1:
+            from .parallel.context import (
+                make_context_parallel_beam_search,
+                validate_cp_mesh,
+            )
+
+            validate_cp_mesh(config, mesh)
+            placement_config = config.replace(vocabulary_size=-1)  # replicated
+            make_caption_fn = make_context_parallel_beam_search
+        else:
+            placement_config = config
+            make_caption_fn = make_parallel_beam_search
         variables = jax.device_put(
             variables, named_shardings(variables, placement_config, mesh)
         )
-        caption_fn = make_parallel_beam_search(
+        caption_fn = make_caption_fn(
             config, mesh, eos,
             beam_size=config.beam_size,
             valid_size=len(vocabulary.words),

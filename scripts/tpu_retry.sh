@@ -1,0 +1,83 @@
+#!/bin/bash
+# Re-run the measurement stages whose artifacts are missing or contain an
+# "error" line, polling the tunneled device between attempts.  The tunnel
+# has been observed dropping for minutes-to-hours mid-session
+# (VERDICT r02 §missing #1, PERF.md dispatch caveat); tpu_session.sh
+# bounds each stage with a timeout so a dead tunnel costs one budget per
+# stage — this script is the complement: it waits for the device to come
+# BACK and then re-runs only what is still unmeasured, cheapest first.
+#
+# Usage: bash scripts/tpu_retry.sh [outdir] [poll_seconds] [max_wait_s]
+set -u
+OUT=${1:-/root/repo/runs/tpu_session_r3}
+POLL=${2:-120}
+MAX_WAIT=${3:-14400}
+cd "$(dirname "$0")/.."
+mkdir -p "$OUT"
+
+ORDER="bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 pallas profile"
+
+stage_cmd() {
+  case "$1" in
+    bench_rng_threefry)   echo "env BENCH_RNG_IMPL=threefry2x32 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
+    bench_remat_decoder)  echo "env BENCH_REMAT=1 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
+    bench_remat_cnn_joint) echo "env BENCH_TRAIN_CNN=1 BENCH_REMAT_CNN=1 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
+    bench_resnet50)       echo "env BENCH_CNN=resnet50 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
+    bench_B256)           echo "env BENCH_BATCH=256 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
+    pallas)               echo "timeout 500 python scripts/bench_pallas.py" ;;
+    profile)              echo "timeout 900 bash scripts/profile_trace.sh $OUT" ;;
+  esac
+}
+
+artifact() {
+  case "$1" in
+    pallas)  echo "$OUT/pallas.txt" ;;
+    profile) echo "$OUT/profile_done.txt" ;;
+    *)       echo "$OUT/$1.json" ;;
+  esac
+}
+
+needed() {  # artifact missing, empty, or an error line at the tail
+  local f; f=$(artifact "$1")
+  [ -s "$f" ] || return 0
+  tail -1 "$f" | grep -q '"error"' && return 0
+  return 1
+}
+
+probe_ok() {
+  timeout 150 python bench.py --probe >/dev/null 2>&1
+}
+
+deadline=$(( $(date +%s) + MAX_WAIT ))
+while :; do
+  pending=""
+  for s in $ORDER; do needed "$s" && pending="$pending $s"; done
+  [ -z "$pending" ] && { echo "all stages measured; nothing to do"; exit 0; }
+  [ "$(date +%s)" -ge "$deadline" ] && { echo "deadline reached; still pending:$pending"; exit 1; }
+
+  if probe_ok; then
+    for s in $pending; do
+      echo "=== retrying $s ==="
+      # stdout goes to a temp file first: a failed stage's error text must
+      # not land in the artifact slot, where needed() would mistake it for
+      # a measurement on the next pass
+      f=$(artifact "$s")
+      eval "$(stage_cmd "$s")" >"$f.tmp" 2>"$OUT/$s.log"
+      rc=$?
+      if [ "$rc" -eq 0 ]; then
+        mv "$f.tmp" "$f"
+      else
+        cat "$f.tmp" >>"$OUT/$s.log"; rm -f "$f.tmp"
+      fi
+      if [ "$rc" -ne 0 ] || needed "$s"; then
+        echo "stage $s still failing (rc=$rc); re-probing before next stage"
+        probe_ok || break   # device gone again — back to polling
+      else
+        echo "stage $s landed: $(tail -1 "$f")"
+      fi
+    done
+  else
+    echo "$(date -u +%H:%M:%S) device unreachable; sleeping ${POLL}s"
+  fi
+  sleep "$POLL"
+done

@@ -264,3 +264,104 @@ def test_cp_fc_activity_matches_single_device(rng):
     want = (float(total_s) - float(total_0)) / s
     assert want > 0
     np.testing.assert_allclose(float(metrics_cp["fc_activity"]), want, rtol=1e-4)
+
+
+@pytest.mark.parametrize("layers", [1, 2])
+def test_cp_beam_search_matches_single_device(rng, layers):
+    """Context-parallel beam search (grid sharded over 4 model shards,
+    distributed-softmax attend) must reproduce the single-device search
+    exactly: same words/lengths, same scores, and the shard-local alphas
+    must reassemble to the global attention maps via the out_spec."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from sat_tpu.ops.beam_search import BeamResult, beam_search
+    from sat_tpu.parallel.context import cp_beam_search
+
+    config = _cfg(num_attend_layers=layers, mesh_shape=(2, 4), beam_size=3)
+    mesh = make_mesh(config)
+    params = init_decoder_params(jax.random.PRNGKey(0), config)
+
+    B = 4
+    N, D = config.num_ctx, config.dim_ctx
+    contexts = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+    eos, vs = 1, 40
+
+    want = beam_search(
+        params, config, contexts, eos, valid_size=vs, return_alphas=True
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P("data", "model", None)),
+        out_specs=BeamResult(
+            words=P("data"), log_scores=P("data"), lengths=P("data"),
+            alphas=P("data", None, None, "model"),
+        ),
+        check_vma=False,
+    )
+    def run(p, ctx):
+        return cp_beam_search(
+            p, config, ctx, eos, valid_size=vs, return_alphas=True
+        )
+
+    got = run(params, contexts)
+    np.testing.assert_array_equal(np.asarray(got.words), np.asarray(want.words))
+    np.testing.assert_array_equal(
+        np.asarray(got.lengths), np.asarray(want.lengths)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.log_scores), np.asarray(want.log_scores),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.alphas), np.asarray(want.alphas), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_cp_caption_fn_end_to_end(rng):
+    """make_context_parallel_beam_search: GSPMD encoder + shard_map CP
+    decode in one jitted program equals single-device encode+search."""
+    from sat_tpu.models.captioner import encode
+    from sat_tpu.ops.beam_search import beam_search
+    from sat_tpu.parallel.context import make_context_parallel_beam_search
+
+    config = _cfg(mesh_shape=(2, 4), context_parallel=4, beam_size=2)
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    mesh = make_mesh(config)
+    variables = {"params": state.params}
+    images = jnp.asarray(
+        rng.normal(size=(4, config.image_size, config.image_size, 3)).astype(
+            np.float32
+        )
+    )
+    eos, vs = 1, 40
+
+    contexts, _ = encode(variables, config, images, train=False)
+    want = beam_search(
+        state.params["decoder"], config, contexts, eos, valid_size=vs,
+        return_alphas=True,
+    )
+
+    fn = make_context_parallel_beam_search(config, mesh, eos, valid_size=vs)
+    got = fn(variables, images)
+    np.testing.assert_array_equal(np.asarray(got.words), np.asarray(want.words))
+    np.testing.assert_allclose(
+        np.asarray(got.log_scores), np.asarray(want.log_scores),
+        rtol=1e-5, atol=1e-6,
+    )
+
+    # the save_attention_maps production path: factory-built out_specs must
+    # reassemble the context-sharded alphas into the global [B,K,T,N] maps
+    fn_a = make_context_parallel_beam_search(
+        config, mesh, eos, valid_size=vs, return_alphas=True
+    )
+    got_a = fn_a(variables, images)
+    np.testing.assert_array_equal(
+        np.asarray(got_a.words), np.asarray(want.words)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_a.alphas), np.asarray(want.alphas), rtol=1e-4, atol=1e-6
+    )
