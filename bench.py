@@ -42,9 +42,9 @@ to 1.0 off the recorded vgg16 config), BENCH_REMAT=1 / BENCH_REMAT_CNN=1
 (decoder / encoder rematerialization A/Bs),
 BENCH_EVAL=0 (skip the additive eval-decode metric; BENCH_EVAL_ITERS
 sizes its window), BENCH_SWEEP (comma list of extra batch sizes tried
-after the primary windows land — default "64,128" for the frozen-CNN
-config, "0" disables; the final line reports the best measured config
-with the per-batch sweep results attached).
+after the primary windows land — default "64,128,256" for the
+frozen-CNN config, "0" disables; the final line reports the best
+measured config with the per-batch sweep results attached).
 """
 
 from __future__ import annotations
@@ -427,11 +427,14 @@ def run_bench() -> None:
 
     # Batch-size sweep: the chip's best operating point is usually a
     # bigger batch than the B=32 default (the MXU tiles 128 rows); with
-    # the contract line already emitted, trying B∈{64,128} risks nothing
-    # and the final line reports the best measured config.  Skipped for
-    # the A/B variants (joint CNN can OOM at B=128 without remat;
-    # BENCH_SWEEP=0 disables).
-    sweep_env = os.environ.get("BENCH_SWEEP", "64,128" if not train_cnn else "0")
+    # the contract line already emitted, trying B∈{64,128,256} risks
+    # nothing (per-size try/except; OOM just logs skipped) and the final
+    # line reports the best measured config.  Skipped for the A/B
+    # variants (joint CNN can OOM at B=128 without remat; BENCH_SWEEP=0
+    # disables).
+    sweep_env = os.environ.get(
+        "BENCH_SWEEP", "64,128,256" if not train_cnn else "0"
+    )
     sweep_batches = [
         int(x) for x in sweep_env.split(",") if x.strip() and x.strip() != "0"
     ]

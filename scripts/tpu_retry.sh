@@ -12,8 +12,15 @@ set -u
 OUT=${1:-/root/repo/runs/tpu_session_r3}
 POLL=${2:-120}
 MAX_WAIT=${3:-14400}
+# Deterministic failures (an OOM, a compile crash) must not be re-run
+# until the deadline — they are indistinguishable from tunnel outages
+# only if nobody counts.  A stage that fails this many times WITH the
+# probe succeeding around it is dropped as given-up.
+MAX_ATTEMPTS=${MAX_ATTEMPTS:-3}
 cd "$(dirname "$0")/.."
 mkdir -p "$OUT"
+declare -A ATTEMPTS
+GAVE_UP=""
 
 ORDER="bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 pallas profile"
 
@@ -51,18 +58,36 @@ probe_ok() {
 deadline=$(( $(date +%s) + MAX_WAIT ))
 while :; do
   pending=""
-  for s in $ORDER; do needed "$s" && pending="$pending $s"; done
-  [ -z "$pending" ] && { echo "all stages measured; nothing to do"; exit 0; }
+  for s in $ORDER; do
+    needed "$s" || continue
+    if [ "${ATTEMPTS[$s]:-0}" -ge "$MAX_ATTEMPTS" ]; then
+      case " $GAVE_UP " in *" $s "*) ;; *)
+        echo "stage $s failed $MAX_ATTEMPTS times with the device up — giving up on it"
+        GAVE_UP="$GAVE_UP $s";;
+      esac
+      continue
+    fi
+    pending="$pending $s"
+  done
+  if [ -z "$pending" ]; then
+    if [ -n "$GAVE_UP" ]; then
+      echo "done; gave up on:$GAVE_UP — see their logs in $OUT"; exit 1
+    fi
+    echo "all stages measured; nothing to do"; exit 0
+  fi
   [ "$(date +%s)" -ge "$deadline" ] && { echo "deadline reached; still pending:$pending"; exit 1; }
 
   if probe_ok; then
     for s in $pending; do
-      echo "=== retrying $s ==="
+      ATTEMPTS[$s]=$(( ${ATTEMPTS[$s]:-0} + 1 ))
+      echo "=== retrying $s (attempt ${ATTEMPTS[$s]}/$MAX_ATTEMPTS) ==="
       # stdout goes to a temp file first: a failed stage's error text must
       # not land in the artifact slot, where needed() would mistake it for
-      # a measurement on the next pass
+      # a measurement on the next pass.  Logs append, one header per
+      # attempt — earlier failures are evidence, not scratch space.
       f=$(artifact "$s")
-      eval "$(stage_cmd "$s")" >"$f.tmp" 2>"$OUT/$s.log"
+      echo "--- attempt ${ATTEMPTS[$s]} $(date -u +%FT%TZ) ---" >>"$OUT/$s.log"
+      eval "$(stage_cmd "$s")" >"$f.tmp" 2>>"$OUT/$s.log"
       rc=$?
       if [ "$rc" -eq 0 ]; then
         mv "$f.tmp" "$f"
@@ -71,7 +96,8 @@ while :; do
       fi
       if [ "$rc" -ne 0 ] || needed "$s"; then
         echo "stage $s still failing (rc=$rc); re-probing before next stage"
-        probe_ok || break   # device gone again — back to polling
+        # an outage mid-stage shouldn't count against the attempt cap
+        probe_ok && : || { ATTEMPTS[$s]=$(( ${ATTEMPTS[$s]} - 1 )); break; }
       else
         echo "stage $s landed: $(tail -1 "$f")"
       fi

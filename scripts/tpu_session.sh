@@ -66,36 +66,14 @@ rc=${PIPESTATUS[0]}
 [ "$rc" -ne 0 ] && { echo "STAGE FAILED: pallas (rc=$rc)"; FAILED="$FAILED pallas"; }
 
 echo "=== stage 2b: jax.profiler trace of the train hot loop ==="
-# one real trace backing the step-time/PrefetchLoader claims (r1 ask #8)
-timeout 300 python scripts/quality_run.py --corpus-only --out "$OUT/profile_run" \
-  >"$OUT/profile_corpus.log" 2>&1
+# one real trace backing the step-time/PrefetchLoader claims (r1 ask #8);
+# profile_trace.sh owns the capture AND the artifact contract
+# (profile_done.txt) shared with tpu_retry.sh
+timeout 1200 bash scripts/profile_trace.sh "$OUT"
 rc=$?
 if [ "$rc" -ne 0 ]; then
-  echo "STAGE FAILED: profile corpus gen (rc=$rc) — see $OUT/profile_corpus.log"
+  echo "STAGE FAILED: profiler trace (rc=$rc) — see $OUT/profile_train.log"
   FAILED="$FAILED profile"
-else
-  PROF="$OUT/profile_run_trace"
-  timeout 900 python -m sat_tpu.cli --phase=train \
-    --set train_image_dir="$OUT/profile_run/images" \
-    --set train_caption_file="$OUT/profile_run/captions.json" \
-    --set vocabulary_file="$OUT/profile_run/vocabulary_basic.csv" \
-    --set temp_annotation_file="$OUT/profile_run/anns_basic.csv" \
-    --set temp_data_file="$OUT/profile_run/data_basic.npy" \
-    --set save_dir="$OUT/profile_run/models2" \
-    --set summary_dir="$OUT/profile_run/summary2" \
-    --set max_train_ann_num=none --set batch_size=32 --set num_epochs=30 \
-    --set max_steps=25 --set save_period=0 \
-    --set profile_dir="$PROF" --set profile_start_step=8 \
-    --set profile_num_steps=5 >"$OUT/profile_train.log" 2>&1
-  rc=$?
-  # a COMPLETE trace only: partial dirs from a mid-trace kill don't count
-  if [ "$rc" -eq 0 ] && { ls "$PROF"/plugins/profile/*/*.xplane.pb >/dev/null 2>&1 || \
-       ls "$PROF"/plugins/profile/*/*.trace.json.gz >/dev/null 2>&1; }; then
-    echo "profiler trace captured under $PROF"
-  else
-    echo "STAGE FAILED: profiler trace (rc=$rc) — see $OUT/profile_train.log"
-    FAILED="$FAILED profile"
-  fi
 fi
 
 echo "=== stage 3: flagship quality run ==="
