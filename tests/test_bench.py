@@ -75,3 +75,63 @@ def test_orchestrator_reports_deterministic_child_failure_as_bench_failed():
     parsed = json.loads(lines[-1])
     assert parsed["error"] == "bench_failed"
     assert parsed["child_rc"] not in (None, 0)
+
+
+RETRY = os.path.join(os.path.dirname(__file__), "..", "scripts", "tpu_retry.sh")
+
+
+def _run_retry(tmp_path, stage_cmd, probe_cmd="true", stages="stage_a",
+               max_attempts="3", timeout=60, poll="0", max_wait="30"):
+    env = dict(
+        os.environ,
+        RETRY_STAGES=stages,
+        RETRY_STAGE_CMD=stage_cmd,
+        RETRY_PROBE_CMD=probe_cmd,
+        MAX_ATTEMPTS=max_attempts,
+    )
+    return subprocess.run(
+        ["bash", RETRY, str(tmp_path), poll, max_wait],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def test_retry_success_writes_artifact_and_exits_zero(tmp_path):
+    proc = _run_retry(tmp_path, stage_cmd="echo '{\"value\": 1}'")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "landed" in proc.stdout
+    with open(tmp_path / "stage_a.json") as f:
+        assert json.load(f)["value"] == 1
+
+
+def test_retry_gives_up_on_deterministic_failure(tmp_path):
+    """A stage failing with the probe green must stop at MAX_ATTEMPTS —
+    not burn the whole deadline re-running the same OOM/crash — and its
+    failure output must land in the (appended) log, never the artifact."""
+    proc = _run_retry(tmp_path, stage_cmd="sh -c 'echo junk-output; exit 7'")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "giving up" in proc.stdout
+    # artifact slot must stay empty: junk stdout is not a measurement
+    assert not (tmp_path / "stage_a.json").exists()
+    log = (tmp_path / "stage_a.log").read_text()
+    assert log.count("--- attempt") == 3
+    assert "junk-output" in log
+
+
+def test_retry_polls_while_device_unreachable(tmp_path):
+    """With the probe failing the stage must never run; the deadline
+    expiry reports the stage as still pending."""
+    proc = _run_retry(
+        tmp_path,
+        stage_cmd="echo should-not-run",
+        probe_cmd="false",
+        poll="1",
+        max_wait="2",
+        timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "still pending: stage_a" in proc.stdout
+    assert "device unreachable" in proc.stdout
+    assert not (tmp_path / "stage_a.json").exists()
