@@ -191,6 +191,9 @@ def cp_beam_search(
             train=False, rng=None, ctx_proj=proj_tiled,
         )
 
+    # early exit is exact and shard-consistent: the cond reduces over
+    # replicated fin/live scores, so every model shard computes the same
+    # trip count (no collective in the predicate)
     return run_search(
         config, step_fn, state0, B, eos_id,
         beam_size=K, max_len=max_len, valid_size=valid_size,

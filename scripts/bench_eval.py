@@ -29,7 +29,35 @@ def main() -> int:
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--cpu", action="store_true")
+    # A/B control for the search's exact early exit (ops/beam_search.py);
+    # note the random-init model here never emits eos from the top-K set,
+    # so both arms measure the full-T worst case — the flag exists for
+    # trained-checkpoint measurements via --params
+    ap.add_argument("--no-early-exit", action="store_true")
+    ap.add_argument(
+        "--params",
+        default=None,
+        help="checkpoint .npz to decode with (a trained model terminates "
+        "early; random init is the worst case)",
+    )
+    ap.add_argument(
+        "--vocab",
+        default=None,
+        help="vocabulary CSV of the checkpoint's run — required with "
+        "--params: derives the real '.' eos id and the valid_size mask "
+        "the production decode applies (runtime.py decode_dataset)",
+    )
+    ap.add_argument(
+        "--vocab-size",
+        type=int,
+        default=None,
+        help="the checkpoint run's config.vocabulary_size (logit width) "
+        "when it differs from the default",
+    )
     args = ap.parse_args()
+    if args.params and not args.vocab:
+        ap.error("--params requires --vocab (eos id + valid_size must come "
+                 "from the run's vocabulary, not a fixed index)")
 
     if args.cpu:
         # both mechanisms: the env's sitecustomize imports jax itself and
@@ -57,14 +85,48 @@ def main() -> int:
         rng.normal(size=(B, args.image_size, args.image_size, 3)).astype(np.float32)
     )
     variables = init_variables(jax.random.PRNGKey(0), config)
-    eos = 1  # any fixed vocab index; cost is termination-independent worst case
+    eos = 1  # any fixed vocab index; random init never tops it → worst case
+    valid_size = None
+    if args.params:
+        from sat_tpu.data.vocabulary import Vocabulary
+        from sat_tpu.runtime import _eos_id
+        from sat_tpu.train.step import create_train_state
+
+        vocab = Vocabulary(config.vocabulary_size, save_file=args.vocab)
+        if args.vocab_size:
+            config = config.replace(vocabulary_size=args.vocab_size)
+        eos = _eos_id(vocab)
+        valid_size = len(vocab.words)
+        skeleton = create_train_state(jax.random.PRNGKey(0), config)
+        # partial restore guard: a shape-skipped decoder would silently
+        # benchmark random weights as "trained" (restore skips
+        # mismatches), so count the params group by itself — the total
+        # from restore_checkpoint also includes optimizer slots, which
+        # would mask a skipped leaf
+        from sat_tpu.train.checkpoint import _assign_leaves, load_flat
+
+        flat = load_flat(args.params)
+        params, n_p = _assign_leaves(skeleton.params, "params/", flat)
+        n_params = len(jax.tree_util.tree_leaves(skeleton.params))
+        if n_p < n_params:
+            print(
+                f"checkpoint covered {n_p}/{n_params} param leaves — wrong "
+                "config/--vocab-size for this checkpoint?",
+                file=sys.stderr,
+            )
+            return 2
+        batch_stats, _ = _assign_leaves(skeleton.batch_stats, "batch_stats/", flat)
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
 
     @jax.jit
     def decode(variables, images):
         contexts, _ = encode(variables, config, images, train=False)
         out = beam_search_jit(
             variables["params"]["decoder"], config, contexts, eos,
-            beam_size=args.beam,
+            beam_size=args.beam, valid_size=valid_size,
+            early_exit=not args.no_early_exit,
         )
         # serializing dependency for chained timing: a score-derived term
         # too small to perturb fp32 image pixels (block_until_ready on
@@ -94,6 +156,7 @@ def main() -> int:
                 "unit": f"images/sec @ beam={args.beam}",
                 "batch_size": B,
                 "batch_ms": round(1e3 * elapsed / args.iters, 1),
+                "early_exit": not args.no_early_exit,
                 "device_kind": getattr(dev, "device_kind", dev.platform),
             }
         ),

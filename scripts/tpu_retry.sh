@@ -37,7 +37,8 @@ stage_cmd() {
     bench_B256)           echo "env BENCH_BATCH=256 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
     pallas)               echo "timeout 500 python scripts/bench_pallas.py" ;;
     profile)              echo "timeout 900 bash scripts/profile_trace.sh $OUT" ;;
-    *) echo "echo \"unknown stage: $1\" >&2; exit 64" ;;
+    # subshell so the exit fails the STAGE, not the retry loop itself
+    *) echo "( echo \"unknown stage: $1\" >&2; exit 64 )" ;;
   esac
 }
 

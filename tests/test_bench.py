@@ -135,3 +135,22 @@ def test_retry_polls_while_device_unreachable(tmp_path):
     assert "still pending: stage_a" in proc.stdout
     assert "device unreachable" in proc.stdout
     assert not (tmp_path / "stage_a.json").exists()
+
+
+def test_retry_unknown_stage_fails_stage_not_script(tmp_path):
+    """A typo'd stage name must burn its attempts and be given up on —
+    the eval'd fallback exits a SUBSHELL, not the retry loop."""
+    env = dict(
+        os.environ,
+        RETRY_STAGES="bench_resnet5O",  # typo
+        RETRY_PROBE_CMD="true",
+        MAX_ATTEMPTS="2",
+    )
+    proc = subprocess.run(
+        ["bash", RETRY, str(tmp_path), "0", "20"],
+        env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "giving up" in proc.stdout
+    assert not (tmp_path / "bench_resnet5O.json").exists()
+    assert "unknown stage" in (tmp_path / "bench_resnet5O.log").read_text()
