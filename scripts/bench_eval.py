@@ -84,7 +84,6 @@ def main() -> int:
     images = jax.device_put(
         rng.normal(size=(B, args.image_size, args.image_size, 3)).astype(np.float32)
     )
-    variables = init_variables(jax.random.PRNGKey(0), config)
     eos = 1  # any fixed vocab index; random init never tops it → worst case
     valid_size = None
     if args.params:
@@ -92,9 +91,11 @@ def main() -> int:
         from sat_tpu.runtime import _eos_id
         from sat_tpu.train.step import create_train_state
 
-        vocab = Vocabulary(config.vocabulary_size, save_file=args.vocab)
         if args.vocab_size:
             config = config.replace(vocabulary_size=args.vocab_size)
+        # width set BEFORE loading: Vocabulary clamps its word list to
+        # size, so the default width would truncate a larger run's CSV
+        vocab = Vocabulary(config.vocabulary_size, save_file=args.vocab)
         eos = _eos_id(vocab)
         valid_size = len(vocab.words)
         skeleton = create_train_state(jax.random.PRNGKey(0), config)
@@ -119,6 +120,8 @@ def main() -> int:
         variables = {"params": params}
         if batch_stats:
             variables["batch_stats"] = batch_stats
+    else:
+        variables = init_variables(jax.random.PRNGKey(0), config)
 
     @jax.jit
     def decode(variables, images):

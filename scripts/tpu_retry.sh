@@ -25,7 +25,7 @@ GAVE_UP=""
 # RETRY_STAGES / RETRY_STAGE_CMD / RETRY_PROBE_CMD exist so the
 # give-up/artifact bookkeeping is testable without a device
 # (tests/test_bench.py); production runs never set them.
-ORDER=${RETRY_STAGES:-"bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 pallas profile"}
+ORDER=${RETRY_STAGES:-"bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 pallas profile bench_early_exit"}
 
 stage_cmd() {
   if [ -n "${RETRY_STAGE_CMD:-}" ]; then echo "$RETRY_STAGE_CMD"; return; fi
@@ -37,6 +37,8 @@ stage_cmd() {
     bench_B256)           echo "env BENCH_BATCH=256 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
     pallas)               echo "timeout 500 python scripts/bench_pallas.py" ;;
     profile)              echo "timeout 900 bash scripts/profile_trace.sh $OUT" ;;
+    # outer timeout > sum of the script's internal budgets (300+700+2*400)
+    bench_early_exit)     echo "timeout 1900 bash scripts/bench_early_exit.sh $OUT" ;;
     # subshell so the exit fails the STAGE, not the retry loop itself
     *) echo "( echo \"unknown stage: $1\" >&2; exit 64 )" ;;
   esac
