@@ -71,6 +71,10 @@ class Config:
     save_period: int = 50
     save_dir: str = "./data/models/"
     summary_dir: str = "./summary/"
+    # overlap checkpoint disk writes with training (single-process; the
+    # multi-host path always saves synchronously) — the reference stalls
+    # its loop for the whole save (base_model.py:61-62)
+    async_checkpoint: bool = True
 
     # ---- dataset-size caps (reference config.py:60-63) ----
     max_train_ann_num: Optional[int] = 1000

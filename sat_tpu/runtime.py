@@ -32,6 +32,7 @@ from .evalcap.eval import CocoEvalCap
 from .models.captioner import encode
 from .ops.beam_search import beam_search_jit
 from .train.checkpoint import (
+    AsyncCheckpointWriter,
     apply_cnn_import,
     import_reference_checkpoint,
     restore_checkpoint,
@@ -166,7 +167,25 @@ def train(
     if start_epoch < config.num_epochs:
         dataset.seek(start_epoch, skip_batches)
     stopped = False
-    with SummaryWriter(config.summary_dir) as writer:
+    # async checkpointing: the step loop pays only the device→host
+    # snapshot; serialization + disk write overlap the following steps
+    # (AsyncCheckpointWriter docstring; sync fallback multi-host/off)
+    async_writer = (
+        AsyncCheckpointWriter()
+        if config.async_checkpoint and jax.process_count() == 1
+        else None
+    )
+    ckpt_save = async_writer.save if async_writer else save_checkpoint
+    import contextlib
+
+    # the ExitStack drains the async writer LAST (after SummaryWriter
+    # closes), on success and on exception alike — queued checkpoint
+    # writes survive an interrupt and worker failures surface
+    with contextlib.ExitStack() as _stack, SummaryWriter(
+        config.summary_dir
+    ) as writer:
+        if async_writer:
+            _stack.callback(async_writer.close)
         for epoch in range(start_epoch, config.num_epochs):
             # per-batch visibility, tqdm-style (reference base_model.py:49-50);
             # metric-free so the async dispatch chain never syncs for it
@@ -214,7 +233,7 @@ def train(
                 ):
                     writer.variable_stats(step, state.params)
                 if config.save_period and step % config.save_period == 0:
-                    save_checkpoint(state, config)
+                    ckpt_save(state, config)
                 bar.update()
             bar.close()
             if stopped:
@@ -223,7 +242,11 @@ def train(
         if profiling:
             jax.block_until_ready(state)
             jax.profiler.stop_trace()
-        save_checkpoint(state, config)
+        # the final save rides the same queue: submission order guarantees
+        # it lands AFTER any still-draining periodic write (config.json
+        # must end at the final step), and the ExitStack close joins the
+        # worker before train() returns
+        ckpt_save(state, config)
     return state
 
 

@@ -111,6 +111,94 @@ def state_to_flat(state: Any) -> Dict[str, np.ndarray]:
 # ---------------------------------------------------------------------------
 
 
+class AsyncCheckpointWriter:
+    """Overlaps checkpoint disk writes with training.
+
+    The reference stalls its hot loop every ``save_period=50`` steps while
+    every variable is pulled to host AND written out
+    (/root/reference/base_model.py:61-62,242-255).  On TPU the
+    device→host snapshot is the only part that must synchronize with the
+    step stream — the state is donated into the next dispatched step
+    (train/step.py donate_argnums), so its buffers must be materialized
+    on host before training proceeds — but npz serialization + disk I/O
+    (hundreds of MB with Adam slots) have no such constraint.  ``save``
+    therefore snapshots synchronously and hands the numpy tree to a
+    single worker thread; saves serialize in submission order, worker
+    failures surface on the next ``save``/``close`` (the PrefetchLoader
+    error contract), and ``close`` drains the queue.
+
+    Single-process only: the multi-host save path needs a cross-host
+    barrier in line with the step stream, so ``save`` falls back to the
+    synchronous writer when ``jax.process_count() > 1``.
+    """
+
+    def __init__(self) -> None:
+        import queue
+        import threading
+
+        # bounded like PrefetchLoader's queue (data/images.py): each item
+        # is a full host snapshot (hundreds of MB with Adam slots), so a
+        # slow disk must apply backpressure on save() — degrading toward
+        # sync-save speed — rather than stack snapshots until OOM
+        self._q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name="sat-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            flat, path, config, save_dir = item
+            try:
+                _write_flat(flat, path, config, save_dir)
+            except BaseException as e:  # surfaced on next save/close
+                if self._error is None:  # keep the FIRST failure (root cause)
+                    self._error = e
+
+    def _check(self) -> None:
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from e
+
+    def save(self, state: Any, config: Config, save_dir: Optional[str] = None) -> str:
+        self._check()
+        if jax.process_count() > 1:
+            return save_checkpoint(state, config, save_dir)
+        save_dir = save_dir or config.save_dir
+        flat = state_to_flat(state)  # the synchronous part
+        step = int(flat["global_step"])
+        path = os.path.join(save_dir, f"{step}.npz")
+        self._q.put((flat, path, config, save_dir))
+        return path
+
+    def close(self) -> None:
+        """Drain pending writes; re-raise the first worker failure."""
+        self._q.put(None)
+        self._thread.join()
+        self._check()
+
+    def __enter__(self) -> "AsyncCheckpointWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _write_flat(
+    flat: Dict[str, np.ndarray], path: str, config: Config, save_dir: str
+) -> None:
+    """The disk half of a checkpoint save (shared by the sync and async
+    paths): atomic npz + config.json sidecar."""
+    step = int(flat["global_step"])
+    # write through the file object: np.savez(path) appends '.npz' itself
+    atomic_write(path, "wb", lambda f: np.savez(f, **flat))
+    config.replace(global_step=step).save(os.path.join(save_dir, "config.json"))
+
+
 def save_checkpoint(state: Any, config: Config, save_dir: Optional[str] = None) -> str:
     """Write ``<global_step>.npz`` + ``config.json`` under save_dir.
 
@@ -126,11 +214,7 @@ def save_checkpoint(state: Any, config: Config, save_dir: Optional[str] = None) 
     if jax.process_index() == 0:
         # process 0 writes; other hosts only participated in the gather
         # (the reference's chief-writes checkpointing, main_distributed.py:64)
-        # write through the file object: np.savez(path) appends '.npz' itself
-        atomic_write(path, "wb", lambda f: np.savez(f, **flat))
-        config.replace(global_step=step).save(
-            os.path.join(save_dir, "config.json")
-        )
+        _write_flat(flat, path, config, save_dir)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

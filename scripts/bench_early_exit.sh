@@ -16,9 +16,11 @@ set -u
 OUT=${1:-/root/repo/runs/tpu_session_r3}
 IMG=${EE_IMAGE_SIZE:-224}
 STEPS=${EE_STEPS:-400}
-# cache dir keyed on the knobs that shape corpus + checkpoint, so a
-# smoke run can't be mistaken for the production artifacts
-DIR="$OUT/ee_run_${IMG}px_${STEPS}s"
+# cache dir keyed on EVERY knob that shapes corpus + checkpoint —
+# including the backend, so a CPU smoke run with default sizes can't be
+# mistaken for the production (TPU-trained) artifacts
+BACKEND=$([ "${EE_CPU:-0}" = "1" ] && echo cpu || echo dev)
+DIR="$OUT/ee_run_${IMG}px_${STEPS}s_${BACKEND}"
 BATCH=${EE_BATCH:-32}
 CPU_FLAG=""
 [ "${EE_CPU:-0}" = "1" ] && { CPU_FLAG="--cpu"; export JAX_PLATFORMS=cpu; }

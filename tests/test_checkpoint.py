@@ -1,5 +1,7 @@
 """Checkpoint save/restore/import/trim (SURVEY.md §2.12, §2.29, §3.5)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -380,3 +382,83 @@ def test_export_import_reference_roundtrip(tmp_path, cnn):
         jax.tree_util.tree_flatten_with_path(imported.batch_stats)[0],
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(pa))
+
+
+def test_async_writer_matches_sync_save(tmp_path, rng):
+    """AsyncCheckpointWriter must produce byte-equivalent checkpoints to
+    the synchronous path, in submission order, and close() must drain."""
+    from sat_tpu.train.checkpoint import AsyncCheckpointWriter
+
+    config = _tiny_config(save_dir=str(tmp_path / "async"))
+    os.makedirs(config.save_dir, exist_ok=True)
+    sync_dir = str(tmp_path / "sync")
+    os.makedirs(sync_dir, exist_ok=True)
+
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    step = make_jit_train_step(config)
+
+    with AsyncCheckpointWriter() as w:
+        for i in range(3):
+            state, _ = step(state, _batch(config, rng), jax.random.PRNGKey(i))
+            w.save(state, config)
+        save_checkpoint(state, config, save_dir=sync_dir)
+    # post-close: all three landed, newest wins, contents match sync
+    assert latest_checkpoint(config.save_dir).endswith("3.npz")
+    a = dict(np.load(os.path.join(config.save_dir, "3.npz")))
+    s = dict(np.load(os.path.join(sync_dir, "3.npz")))
+    assert set(a) == set(s)
+    for k in a:
+        np.testing.assert_array_equal(a[k], s[k], err_msg=k)
+    # config.json sidecar carries the latest step
+    import json
+    assert json.load(open(os.path.join(config.save_dir, "config.json")))[
+        "global_step"
+    ] == 3
+
+
+def test_async_writer_surfaces_write_failure(tmp_path, rng):
+    """A worker failure (unwritable dir) must raise on close, not vanish."""
+    import pytest
+
+    from sat_tpu.train.checkpoint import AsyncCheckpointWriter
+
+    # a FILE where the save dir should be: the write itself must fail
+    # (atomic_write creates missing directories, so a merely-absent dir
+    # would succeed)
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    config = _tiny_config(save_dir=str(blocker / "sub"))
+    state = create_train_state(jax.random.PRNGKey(0), config)
+
+    w = AsyncCheckpointWriter()
+    w.save(state, config)  # queues a write that cannot land
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        w.close()
+
+
+def test_train_loop_async_checkpoints_restore(coco_fixture, tmp_path):
+    """runtime.train with async_checkpoint on: periodic + final saves all
+    land, and the final checkpoint restores to the final step."""
+    from sat_tpu import runtime
+
+    cfg = coco_fixture["config"].replace(
+        **{**TINY,
+           "max_caption_length": 20,  # TINY's 5 filters out every caption
+           # private cache paths: TINY's vocabulary_size=50 must not
+           # rebuild the session-shared fixture caches other tests load
+           "vocabulary_file": str(tmp_path / "vocab.csv"),
+           "temp_annotation_file": str(tmp_path / "anns.csv"),
+           "temp_data_file": str(tmp_path / "data.npy"),
+           "save_dir": str(tmp_path / "models"),
+           "summary_dir": str(tmp_path / "summary"),
+           "save_period": 2,
+           "max_steps": 5,
+           "num_epochs": 50,
+           "async_checkpoint": True}
+    )
+    state = runtime.train(cfg)
+    names = sorted(os.listdir(cfg.save_dir))
+    assert "2.npz" in names and "4.npz" in names and "5.npz" in names
+    fresh = create_train_state(jax.random.PRNGKey(3), cfg)
+    restored, n = restore_checkpoint(fresh, save_dir=cfg.save_dir)
+    assert n > 0 and int(restored.step) == 5
