@@ -10,11 +10,57 @@ stitches the host shards into one data-sharded global array.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
+import numpy as np
 
 from ..data.dataset import DataSet
+
+
+def mesh_data_shard(mesh) -> Tuple[int, int]:
+    """Map THIS process to its slot along the mesh's 'data' axis.
+
+    Returns ``(shard_index, num_shards)`` for the per-host input feed.
+    The feed must be keyed on the DATA-axis layout, not the process
+    count: when the 'model' axis spans processes (context parallelism or
+    cross-host TP), several processes hold the same data row and must
+    feed identical replicas of it — `jax.make_array_from_process_local_data`
+    maps each process's local rows onto the rows its devices own.
+
+    * every process's devices in one data row (model axis across hosts):
+      that row's index, out of dp rows — pure-CP meshes give (0, 1),
+      every host feeding the full batch;
+    * one-or-more rows per process and dp == global layout (the plain DP
+      case, incl. several rows per process): falls back to
+      ``(process_index, process_count)`` — the contiguous-block ownership
+      of the data-major device order.
+    """
+    axes = list(mesh.axis_names)
+    devs = np.moveaxis(np.asarray(mesh.devices), axes.index("data"), 0)
+    dp = devs.shape[0]
+    rows = {
+        r
+        for r in range(dp)
+        for d in devs[r].flat
+        if d.process_index == jax.process_index()
+    }
+    if len(rows) == 1:
+        return rows.pop(), dp
+    # multi-row fallback: only valid when this process owns EXACTLY the
+    # contiguous row block implied by (process_index, process_count) — a
+    # straddling layout (devices-per-process not a multiple of the model
+    # axis) would silently map the wrong dataset rows onto the owned
+    # shards, so fail loudly instead
+    pi, pc = jax.process_index(), jax.process_count()
+    if dp % pc == 0 and rows == set(range(pi * (dp // pc), (pi + 1) * (dp // pc))):
+        return pi, pc
+    raise ValueError(
+        f"process {pi}'s devices straddle data rows {sorted(rows)} of {dp} "
+        f"(mesh {dict(mesh.shape)} over {pc} processes) — the per-host feed "
+        "cannot map dataset rows onto this layout; use a mesh where each "
+        "process's devices sit in one data row or an exact row block"
+    )
 
 
 def pad_dataset_for_processes(dataset: DataSet, process_count: int) -> DataSet:

@@ -114,7 +114,7 @@ def train(
     if int(np.prod(config.mesh_shape)) > 1:
         from .parallel import make_mesh, make_parallel_train_step
         from .parallel.collectives import make_global_batch
-        from .parallel.data import process_local_dataset
+        from .parallel.data import mesh_data_shard, process_local_dataset
         from .parallel.sharding import shard_train_state
 
         mesh = make_mesh(config)
@@ -134,7 +134,13 @@ def train(
         else:
             state = shard_train_state(state, config, mesh)
             train_step = make_parallel_train_step(config, mesh)
-        dataset = process_local_dataset(dataset)
+        # feed keyed on the DATA-axis layout: processes along the model
+        # axis (CP / cross-host TP) share a data row and feed identical
+        # replicas of it (mesh_data_shard docstring)
+        shard_idx, n_shards = mesh_data_shard(mesh)
+        dataset = process_local_dataset(
+            dataset, process_index=shard_idx, process_count=n_shards
+        )
         place_batch = lambda b: make_global_batch(mesh, b)  # noqa: E731
     else:
         train_step = make_jit_train_step(config)
@@ -284,7 +290,11 @@ def decode_dataset(
     if int(np.prod(config.mesh_shape)) > 1:
         from .parallel import make_mesh
         from .parallel.collectives import make_global_batch
-        from .parallel.data import pad_dataset_for_processes, process_local_dataset
+        from .parallel.data import (
+            mesh_data_shard,
+            pad_dataset_for_processes,
+            process_local_dataset,
+        )
         from .parallel.sharding import named_shardings
         from .parallel.train import make_parallel_beam_search
 
@@ -334,8 +344,14 @@ def decode_dataset(
 
         pc = jax.process_count()
         if pc > 1:
-            padded = pad_dataset_for_processes(dataset, pc)
-            local_ds = process_local_dataset(padded)
+            # split keyed on the data axis, not the process count: under
+            # CP the model-axis processes all feed (and decode) the same
+            # rows, so a pure-CP mesh gives (0, 1) — no split at all
+            shard_idx, n_shards = mesh_data_shard(mesh)
+            padded = pad_dataset_for_processes(dataset, n_shards)
+            local_ds = process_local_dataset(
+                padded, process_index=shard_idx, process_count=n_shards
+            )
             loader = PrefetchLoader(
                 local_ds,
                 ImageLoader(size=config.image_size, raw=config.device_preprocess),
@@ -364,7 +380,7 @@ def decode_dataset(
                     )
                 )
             return _assemble_mesh_results(
-                dataset, vocabulary, gathered, pc, local_ds.count
+                dataset, vocabulary, gathered, n_shards, local_ds.count
             )
 
     else:

@@ -66,6 +66,13 @@ def main() -> int:
         "--join-timeout", type=float, default=900.0,
         help="seconds to wait for the workers before declaring failure",
     )
+    ap.add_argument(
+        "--cp", action="store_true",
+        help="context-parallel mode: mesh (1, procs) with the attention "
+        "grid sharded ACROSS the processes (distributed-softmax psums over "
+        "the loopback DCN) for both training and beam-search decode; every "
+        "host feeds identical full batches (mesh_data_shard)",
+    )
     args = ap.parse_args()
 
     sys.path.insert(0, REPO)
@@ -79,7 +86,9 @@ def main() -> int:
         image_size=32, dim_embedding=16, num_lstm_units=16,
         dim_initialize_layer=16, dim_attend_layer=16, dim_decode_layer=32,
         compute_dtype="float32", num_epochs=1, save_period=0, log_every=1,
-        mesh_shape=(args.procs, 1), batch_size=4, beam_size=2,
+        mesh_shape=(1, args.procs) if args.cp else (args.procs, 1),
+        context_parallel=args.procs if args.cp else 1,
+        batch_size=4, beam_size=2,
         num_data_workers=2, max_eval_ann_num=8,
         # beam-0 alphas ride the cross-host gather; every host renders its
         # interleaved slice of the panels (runtime._local_render_rows)
@@ -169,7 +178,8 @@ def main() -> int:
         print(f"FAIL: {len(panels)} attention panels for {len(results)} "
               "decoded images")
         return 1
-    print(f"MULTIHOST OK: {args.procs} processes, scores agree: "
+    mode = "context-parallel" if args.cp else "data-parallel"
+    print(f"MULTIHOST OK ({mode}): {args.procs} processes, scores agree: "
           f"Bleu_4={scores[0]['Bleu_4']:.3f}; "
           f"{len(panels)} attention panels rendered across hosts")
     return 0

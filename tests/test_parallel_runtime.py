@@ -222,11 +222,25 @@ def test_process_local_dataset_slices_disjointly():
         process_local_dataset(global_ds, process_index=0, process_count=3)
 
 
-def test_multihost_demo_two_real_processes(tmp_path):
+@pytest.mark.parametrize(
+    "extra_args,banner",
+    [
+        ([], "MULTIHOST OK (data-parallel)"),
+        (["--cp"], "MULTIHOST OK (context-parallel)"),
+    ],
+    ids=["dp", "cp"],
+)
+def test_multihost_demo_two_real_processes(tmp_path, extra_args, banner):
     """The full multi-process story, for real: two OS processes bootstrap a
-    jax.distributed cluster over a loopback coordinator, train SPMD with
-    per-host data shards, and run multi-host mesh eval with cross-host
-    result gather — both hosts must finish rc=0 with identical scores."""
+    jax.distributed cluster over a loopback coordinator, train SPMD, and
+    run multi-host mesh eval with cross-host result gather — both hosts
+    must finish rc=0 with identical scores and full panel coverage.
+
+    dp: per-host data shards with XLA gradient all-reduce.  cp: the MODEL
+    axis spans the processes — context-parallel training and beam-search
+    decode whose distributed-softmax psums cross a real process boundary
+    (loopback DCN), every host feeding identical full batches
+    (mesh_data_shard)."""
     import os
     import signal
     import socket
@@ -241,7 +255,7 @@ def test_multihost_demo_two_real_processes(tmp_path):
         [
             sys.executable, os.path.join(repo, "scripts", "multihost_demo.py"),
             "--root", str(tmp_path / "demo"), "--port", str(port),
-            "--join-timeout", "420",
+            "--join-timeout", "420", *extra_args,
         ],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=repo,
         start_new_session=True,  # own process group: timeout kills workers too
@@ -253,7 +267,26 @@ def test_multihost_demo_two_real_processes(tmp_path):
         out, err = proc.communicate()
         raise AssertionError(f"demo timed out\n{out[-2000:]}\n{err[-1500:]}")
     assert proc.returncode == 0, f"{out[-3000:]}\n--- stderr ---\n{err[-1500:]}"
-    assert "MULTIHOST OK" in out
+    assert banner in out
+
+
+def test_mesh_data_shard_maps_model_axis_processes_to_one_row():
+    """Single-process sanity of the feed-shard mapping: dp rows with the
+    whole mesh addressable fall back to (process 0 of 1); a data axis of
+    size 1 maps to (0, 1) — the pure-CP every-host-feeds-everything case."""
+    from sat_tpu.parallel.data import mesh_data_shard
+    from sat_tpu.parallel.mesh import mesh_from_devices
+
+    devs = jax.devices()[:8]
+    assert mesh_data_shard(
+        mesh_from_devices(devs, (2, 4), ("data", "model"))
+    ) == (0, 1)
+    assert mesh_data_shard(
+        mesh_from_devices(devs[:2], (1, 2), ("data", "model"))
+    ) == (0, 1)
+    assert mesh_data_shard(
+        mesh_from_devices(devs[:2], (2, 1), ("data", "model"))
+    ) == (0, 1)
 
 
 def test_pad_dataset_for_processes_handles_pad_beyond_count():
