@@ -50,6 +50,19 @@ from sat_tpu import runtime
 state = runtime.train(config)
 print("[p%d] trained to step %d" % (pid, int(jax.device_get(state.step))), flush=True)
 
+if tuple(config.mesh_shape)[1] > 1 and config.context_parallel == 1:
+    # vocab-TP mode: the banner must not be earnable with silently
+    # replicated params (the placement rule no-ops when vocabulary_size
+    # isn't divisible by the model axis) — demand a leaf actually sharded
+    # over 'model'
+    import jax.tree_util as jtu
+    on_model = any(
+        "model" in str(getattr(l.sharding, "spec", ""))
+        for l in jtu.tree_leaves(state.params)
+    )
+    assert on_model, "TP mode but no param leaf is sharded over 'model'"
+    print("[p%d] TP verified: params sharded over 'model'" % pid, flush=True)
+
 scores = runtime.evaluate(config, state=state)
 with open(os.path.join(root, "scores_p%d.json" % pid), "w") as f:
     json.dump(scores, f)
@@ -73,7 +86,16 @@ def main() -> int:
         "the loopback DCN) for both training and beam-search decode; every "
         "host feeds identical full batches (mesh_data_shard)",
     )
+    ap.add_argument(
+        "--tp", action="store_true",
+        help="vocab tensor-parallel mode: mesh (1, procs) with the "
+        "embedding table and softmax projection sharded ACROSS the "
+        "processes (GSPMD inserts the cross-host collectives); every host "
+        "feeds identical full batches",
+    )
     args = ap.parse_args()
+    if args.cp and args.tp:
+        ap.error("--cp and --tp are mutually exclusive (one model axis)")
 
     sys.path.insert(0, REPO)
     sys.path.insert(0, os.path.join(REPO, "tests"))
@@ -86,7 +108,7 @@ def main() -> int:
         image_size=32, dim_embedding=16, num_lstm_units=16,
         dim_initialize_layer=16, dim_attend_layer=16, dim_decode_layer=32,
         compute_dtype="float32", num_epochs=1, save_period=0, log_every=1,
-        mesh_shape=(1, args.procs) if args.cp else (args.procs, 1),
+        mesh_shape=(1, args.procs) if (args.cp or args.tp) else (args.procs, 1),
         context_parallel=args.procs if args.cp else 1,
         batch_size=4, beam_size=2,
         num_data_workers=2, max_eval_ann_num=8,
@@ -158,6 +180,12 @@ def main() -> int:
         print("FAIL: a worker exited nonzero")
         return 1
 
+    if args.tp and any(
+        "TP verified" not in outputs[p] for p in range(args.procs)
+    ):
+        print("FAIL: a worker did not verify TP sharding over 'model'")
+        return 1
+
     scores = [
         json.load(open(os.path.join(args.root, f"scores_p{p}.json")))
         for p in range(args.procs)
@@ -178,7 +206,11 @@ def main() -> int:
         print(f"FAIL: {len(panels)} attention panels for {len(results)} "
               "decoded images")
         return 1
-    mode = "context-parallel" if args.cp else "data-parallel"
+    mode = (
+        "context-parallel" if args.cp
+        else "tensor-parallel" if args.tp
+        else "data-parallel"
+    )
     print(f"MULTIHOST OK ({mode}): {args.procs} processes, scores agree: "
           f"Bleu_4={scores[0]['Bleu_4']:.3f}; "
           f"{len(panels)} attention panels rendered across hosts")

@@ -227,8 +227,9 @@ def test_process_local_dataset_slices_disjointly():
     [
         ([], "MULTIHOST OK (data-parallel)"),
         (["--cp"], "MULTIHOST OK (context-parallel)"),
+        (["--tp"], "MULTIHOST OK (tensor-parallel)"),
     ],
-    ids=["dp", "cp"],
+    ids=["dp", "cp", "tp"],
 )
 def test_multihost_demo_two_real_processes(tmp_path, extra_args, banner):
     """The full multi-process story, for real: two OS processes bootstrap a
@@ -240,7 +241,9 @@ def test_multihost_demo_two_real_processes(tmp_path, extra_args, banner):
     axis spans the processes — context-parallel training and beam-search
     decode whose distributed-softmax psums cross a real process boundary
     (loopback DCN), every host feeding identical full batches
-    (mesh_data_shard)."""
+    (mesh_data_shard).  tp: same spanning axis, spent instead on the
+    embedding/softmax vocab dimension (GSPMD-inserted cross-host
+    collectives)."""
     import os
     import signal
     import socket
