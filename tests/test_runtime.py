@@ -578,3 +578,27 @@ class TestProgress:
         seen = list(track(range(6), 6, desc="t", stream=out, every=2))
         assert seen == list(range(6))
         assert "t: 6/6" in out.getvalue()
+
+
+def test_eval_decode_with_profiler_window(coco_fixture, tmp_path):
+    """decode_dataset honors the same profiler knobs as train: an eval run
+    with profile_dir set produces a trace over the decode loop."""
+    config = coco_fixture["config"].replace(
+        **{**SMALL_MODEL,
+           "save_dir": str(tmp_path / "models"),
+           "summary_dir": str(tmp_path / "summary"),
+           "eval_result_file": str(tmp_path / "results.json"),
+           "num_epochs": 1}
+    )
+    state = runtime.train(config)
+    # profile_start_step left at its train default (5), far past this
+    # tiny eval's batch count — the decode window must clamp and still fire
+    cfg_prof = config.replace(
+        profile_dir=str(tmp_path / "eval_profile"),
+        profile_num_steps=1,
+    )
+    runtime.evaluate(cfg_prof, state=state)
+    produced = []
+    for root, _, files in os.walk(tmp_path / "eval_profile"):
+        produced += files
+    assert produced, "no eval profiler trace written"
