@@ -31,7 +31,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..models.decoder import (
@@ -41,7 +41,9 @@ from ..models.decoder import (
     _l1,
     decode_logits,
     lstm_step,
+    precompute_attend,
 )
+from ..ops.beam_search import BeamResult, run_search, tile_beams
 from ..train.step import TrainState, split_trainable
 from ..train.optimizer import make_optimizer
 from ..nn.layers import regularization_loss
@@ -171,9 +173,6 @@ def cp_beam_search(
     Exactness: same algebra as the single-device search; the CPU-mesh
     test pins word/score equality against :func:`beam_search`.
     """
-    from ..models.decoder import precompute_attend
-    from ..ops.beam_search import run_search, tile_beams
-
     K = beam_size or config.beam_size
     B, n_local, D = ctx_local.shape
 
@@ -219,17 +218,12 @@ def make_context_parallel_beam_search(
     Returned alphas are reassembled to the global [B, K, T, N] layout by
     the shard_map out_spec (concatenation over AXIS).
     """
-    from jax.sharding import NamedSharding
-
-    from ..models.captioner import encode as _encode
-    from ..ops.beam_search import BeamResult as _BeamResult
-
     K = beam_size or config.beam_size
     batch_sh = NamedSharding(mesh, P("data"))
     rep = P()
     data_specs = P("data")
 
-    out_specs = _BeamResult(
+    out_specs = BeamResult(
         words=data_specs, log_scores=data_specs, lengths=data_specs,
         alphas=P("data", None, None, AXIS) if return_alphas else None,
     )
@@ -248,13 +242,13 @@ def make_context_parallel_beam_search(
         )
 
     def caption(variables, images):
-        contexts, _ = _encode(variables, config, images, train=False)
+        contexts, _ = encode(variables, config, images, train=False)
         return sharded_decode(variables["params"]["decoder"], contexts)
 
     return jax.jit(
         caption,
         in_shardings=(None, batch_sh),
-        out_shardings=_BeamResult(
+        out_shardings=BeamResult(
             words=batch_sh, log_scores=batch_sh, lengths=batch_sh,
             alphas=batch_sh if return_alphas else None,
         ),

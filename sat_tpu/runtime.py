@@ -103,6 +103,7 @@ class ProfilerWindow:
         self._on = False
         self._fired = False
         self._stop_at = -1
+        self._last_sync = None
 
     def before_step(self, i: int) -> None:
         """Call before dispatching step ``i``; opens the window once."""
@@ -116,19 +117,26 @@ class ProfilerWindow:
         """Call after dispatching step ``i``; closes the window when the
         configured step count has been captured (blocks on ``sync`` so
         the trace contains completed device work)."""
+        self._last_sync = sync  # __exit__'s sync target if the loop ends early
         if self._on and i + 1 >= self._stop_at:
             jax.block_until_ready(sync)
             jax.profiler.stop_trace()
             self._on = False
+            self._last_sync = None
 
-    def close(self, sync=None) -> None:
+    def __enter__(self) -> "ProfilerWindow":
+        return self
+
+    def __exit__(self, *exc) -> None:
         """Idempotent tail/error-path stop (loop ended inside the window,
-        or an exception fired mid-window)."""
+        or an exception fired mid-window) — blocks on the last
+        ``after_step`` sync target so the trace holds completed work."""
         if self._on:
-            if sync is not None:
-                jax.block_until_ready(sync)
+            if self._last_sync is not None:
+                jax.block_until_ready(self._last_sync)
             jax.profiler.stop_trace()
             self._on = False
+        self._last_sync = None
 
 
 # ---------------------------------------------------------------------------
@@ -239,10 +247,9 @@ def train(
     ) as writer:
         if async_writer:
             _stack.callback(async_writer.close)
-        # resume-aware trace window (>= start, once); the ExitStack close
+        # resume-aware trace window (>= start, once); the ExitStack exit
         # keeps an exception mid-window from leaving the profiler open
-        prof = ProfilerWindow(config)
-        _stack.callback(prof.close)
+        prof = _stack.enter_context(ProfilerWindow(config))
         for epoch in range(start_epoch, config.num_epochs):
             # per-batch visibility, tqdm-style (reference base_model.py:49-50);
             # metric-free so the async dispatch chain never syncs for it
@@ -284,7 +291,6 @@ def train(
             if stopped:
                 break
             print(f"epoch {epoch + 1}/{config.num_epochs} done (step {int(state.step)})")
-        prof.close(sync=state)  # loop ended inside the window
         # the final save rides the same queue: submission order guarantees
         # it lands AFTER any still-draining periodic write (config.json
         # must end at the final step), and the ExitStack close joins the
@@ -399,8 +405,9 @@ def decode_dataset(
 
             gathered = []
             # same knobs as the other loops; start clamped to batch count
-            prof = ProfilerWindow(config, max_start=local_ds.num_batches - 1)
-            try:
+            with ProfilerWindow(
+                config, max_start=local_ds.num_batches - 1
+            ) as prof:
                 for b, batch in enumerate(
                     track(loader, local_ds.num_batches, desc="decode(mesh)")
                 ):
@@ -421,8 +428,6 @@ def decode_dataset(
                             np.asarray(x) for x in gather_tree_replicated(best)
                         )
                     )
-            finally:
-                prof.close()
             return _assemble_mesh_results(
                 dataset, vocabulary, gathered, n_shards, local_ds.count
             )
@@ -496,8 +501,7 @@ def decode_dataset(
     # train's (shared ProfilerWindow), start clamped to the batch count so
     # a short eval still traces; the trace shows how much of the batch
     # time is the beam program vs encode vs dispatch
-    prof = ProfilerWindow(config, max_start=dataset.num_batches - 1)
-    try:
+    with ProfilerWindow(config, max_start=dataset.num_batches - 1) as prof:
         # per-batch visibility during decode (reference base_model.py:82,131
         # tqdm-bars eval/test; a full-COCO eval would otherwise run silent)
         for b, batch in enumerate(
@@ -509,8 +513,6 @@ def decode_dataset(
             if prev is not None:
                 drain(*prev)
             prev = (out, batch["files"])
-    finally:
-        prof.close(sync=prev[0].words if prev is not None else None)
     if prev is not None:
         drain(*prev)
     return results
