@@ -119,6 +119,14 @@ class Config:
     # key-derivation quality for speed on top of rbg.  Param init always
     # uses threefry so initial weights never depend on this knob.
     rng_impl: str = "rbg"
+    # Master seed for the whole run: param init, dropout key stream, and
+    # the per-epoch shuffle order (DataSet._set_epoch is a pure function
+    # of (seed, epoch), which is also what makes mid-epoch resume replay
+    # bitwise).  Like every other knob, a resumed run must be launched
+    # with the same value (rerun the same command line plus --load); the
+    # checkpoint's config.json sidecar records what it was.  The
+    # reference exposes no seed control at all.
+    seed: int = 0
     # Rematerialize the decoder scan step in the backward pass (keep
     # matmul outputs, regenerate dropout masks/elementwise from the
     # per-step keys instead of stacking T steps of residuals).

@@ -43,7 +43,7 @@ class DataSet:
         self.batch_size = batch_size
         self.is_train = is_train
         self.shuffle = shuffle
-        self._seed = 0 if seed is None else int(seed)
+        self.seed = 0 if seed is None else int(seed)
         self.setup()
 
     def setup(self) -> None:
@@ -62,7 +62,7 @@ class DataSet:
         sequence of an uninterrupted one (the reference's stateful
         shuffle-on-reset, dataset.py:37-41, cannot resume mid-stream)."""
         self.epoch = epoch
-        rng = np.random.default_rng((self._seed, epoch))
+        rng = np.random.default_rng((self.seed, epoch))
         self.idxs = (
             list(rng.permutation(self.count))
             if self.shuffle
@@ -198,6 +198,7 @@ def prepare_train_data(config: Config) -> DataSet:
         masks,
         is_train=True,
         shuffle=True,
+        seed=config.seed,
     )
 
 

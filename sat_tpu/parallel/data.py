@@ -83,6 +83,7 @@ def pad_dataset_for_processes(dataset: DataSet, process_count: int) -> DataSet:
         None if dataset.masks is None else dataset.masks[idx],
         is_train=dataset.is_train,
         shuffle=False,
+        seed=dataset.seed,
     )
 
 
@@ -121,5 +122,7 @@ def process_local_dataset(
         None if dataset.masks is None else dataset.masks[sel],
         is_train=dataset.is_train,
         shuffle=dataset.shuffle,
-        seed=pi,
+        # decorrelated per-shard shuffle, still keyed on the run's base
+        # seed so config.seed controls the full multi-host batch stream
+        seed=dataset.seed * 1009 + pi,
     )

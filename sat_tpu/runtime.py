@@ -55,11 +55,13 @@ def setup_state(
     model_file: Optional[str] = None,
     load_cnn: bool = False,
     cnn_model_file: Optional[str] = None,
-    seed: int = 0,
+    seed: Optional[int] = None,
 ) -> TrainState:
     """Initialize the train state, optionally restoring a checkpoint and/or
     importing a pretrained CNN — the main.py load sequence
     (/root/reference/main.py:49-53)."""
+    if seed is None:
+        seed = config.seed
     state = create_train_state(jax.random.PRNGKey(seed), config)
     if load or model_file:
         if model_file and model_file.endswith(".npy"):
@@ -148,7 +150,7 @@ def train(
     config: Config,
     state: Optional[TrainState] = None,
     dataset: Optional[DataSet] = None,
-    seed: int = 0,
+    seed: Optional[int] = None,
 ) -> TrainState:
     """Epoch × batch training loop (reference base_model.py:39-68).
 
@@ -156,8 +158,14 @@ def train(
     SPMD: state sharded per the (data, model) placement rules, batches
     data-sharded, XLA inserting the gradient all-reduce — the synchronous
     upgrade of the reference's async PS strategy (SURVEY.md §2.13)."""
+    if seed is None:
+        seed = config.seed
     if dataset is None:
-        dataset = prepare_train_data(config)
+        # the explicit kwarg must drive the WHOLE run — shuffle order
+        # included — not just init/dropout (batch order is f(seed, epoch))
+        dataset = prepare_train_data(
+            config if seed == config.seed else config.replace(seed=seed)
+        )
     if dataset.count == 0:
         raise ValueError(
             "training dataset is empty after preparation — every caption was "

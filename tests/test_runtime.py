@@ -602,3 +602,34 @@ def test_eval_decode_with_profiler_window(coco_fixture, tmp_path):
     for root, _, files in os.walk(tmp_path / "eval_profile"):
         produced += files
     assert produced, "no eval profiler trace written"
+
+
+def test_config_seed_controls_the_run(coco_fixture, tmp_path):
+    """config.seed drives param init, the dropout key stream, and the
+    shuffle order end-to-end: identical seeds reproduce the trained
+    params bitwise, a different seed diverges.  (The reference exposes no
+    seed control at all.)"""
+    import jax.tree_util as jtu
+
+    def run(seed, tag):
+        cfg = coco_fixture["config"].replace(
+            **{**SMALL_MODEL,
+               "seed": seed,
+               "max_steps": 3,
+               "save_dir": str(tmp_path / f"m{tag}"),
+               "summary_dir": str(tmp_path / f"s{tag}")}
+        )
+        return runtime.train(cfg)
+
+    a = run(7, "a")
+    b = run(7, "b")
+    c = run(8, "c")
+    flat_a = jtu.tree_leaves(a.params)
+    flat_b = jtu.tree_leaves(b.params)
+    flat_c = jtu.tree_leaves(c.params)
+    for xa, xb in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+    assert any(
+        not np.array_equal(np.asarray(xa), np.asarray(xc))
+        for xa, xc in zip(flat_a, flat_c)
+    )
