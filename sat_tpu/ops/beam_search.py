@@ -62,6 +62,11 @@ class BeamResult(NamedTuple):
     # (soft-attention α over the context grid at the step that emitted
     # word t); None unless return_alphas was set
     alphas: Optional[jnp.ndarray] = None
+    # scalar int32 count of decode-loop iterations actually executed —
+    # the deterministic observability probe for the early exit (None
+    # unless return_steps was set, so the default output pytree — and
+    # the shard_map out_specs built from it — is unchanged)
+    steps_run: Optional[jnp.ndarray] = None
 
 
 def run_search(
@@ -76,6 +81,7 @@ def run_search(
     return_alphas: bool = False,
     alpha_width: Optional[int] = None,
     early_exit: bool = True,
+    return_steps: bool = False,
 ) -> BeamResult:
     """The search engine shared by the single-device and context-parallel
     decode paths.
@@ -195,7 +201,7 @@ def run_search(
 
     carry = (state, live_logp, live_words, live_len, last_word,
              fin_logp, fin_words, fin_len, live_alphas, fin_alphas)
-    _, carry = jax.lax.while_loop(cond, body, (jnp.int32(0), carry))
+    t_final, carry = jax.lax.while_loop(cond, body, (jnp.int32(0), carry))
     (_, live_logp, live_words, live_len, _,
      fin_logp, fin_words, fin_len, live_alphas, fin_alphas) = carry
 
@@ -221,6 +227,7 @@ def run_search(
         log_scores=cand_logp[batch_idx, sel],
         lengths=cand_len[batch_idx, sel],
         alphas=alphas,
+        steps_run=t_final if return_steps else None,
     )
 
 
@@ -245,6 +252,7 @@ def beam_search(
     hoist_attention: bool = True,
     return_alphas: bool = False,
     early_exit: bool = True,
+    return_steps: bool = False,
 ) -> BeamResult:
     """Decode captions for a batch of context grids.
 
@@ -292,6 +300,7 @@ def beam_search(
         config, step_fn, state0, B, eos_id,
         beam_size=K, max_len=max_len, valid_size=valid_size,
         return_alphas=return_alphas, alpha_width=N, early_exit=early_exit,
+        return_steps=return_steps,
     )
 
 

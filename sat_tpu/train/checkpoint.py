@@ -142,6 +142,13 @@ class AsyncCheckpointWriter:
         # sync-save speed — rather than stack snapshots until OOM
         self._q: "queue.Queue" = queue.Queue(maxsize=2)
         self._error: Optional[BaseException] = None
+        # _error crosses the worker/caller thread boundary; the lock makes
+        # that handoff explicit rather than leaning on CPython's per-ref
+        # atomicity.  It does NOT close the save()-time window between
+        # _check and put() — a failure landing there surfaces on the NEXT
+        # call, which is what the permanent-failure contract in _check
+        # guarantees (the actual ADVICE r3 fix).
+        self._error_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._run, name="sat-ckpt-writer", daemon=True
         )
@@ -156,12 +163,18 @@ class AsyncCheckpointWriter:
             try:
                 _write_flat(flat, path, config, save_dir)
             except BaseException as e:  # surfaced on next save/close
-                if self._error is None:  # keep the FIRST failure (root cause)
-                    self._error = e
+                with self._error_lock:
+                    if self._error is None:  # keep the FIRST failure (root cause)
+                        self._error = e
 
     def _check(self) -> None:
-        if self._error is not None:
-            e, self._error = self._error, None
+        # the failure is permanent: a writer that lost a snapshot cannot
+        # promise anything about later ones, so every subsequent
+        # save()/close() re-raises the same root cause rather than
+        # silently resuming
+        with self._error_lock:
+            e = self._error
+        if e is not None:
             raise RuntimeError("async checkpoint write failed") from e
 
     def save(self, state: Any, config: Config, save_dir: Optional[str] = None) -> str:

@@ -371,9 +371,9 @@ def test_early_exit_is_exact():
 def test_early_exit_actually_exits():
     """With the decode bias rigged so eos dominates every step, all beams
     finish immediately; the early-exit search must (a) still equal the
-    full-length control and (b) demonstrably stop: at T=40 the exited
-    program runs the loop ~2 steps instead of 40, which shows as a large
-    steady-state wall-clock gap even on CPU."""
+    full-length control and (b) demonstrably stop.  The stop is asserted
+    on the deterministic steps_run probe (the while_loop's final t), not
+    wall-clock — timing on a loaded CI box is advisory only (ADVICE r3)."""
     import time
 
     cfg, params, contexts = setup(seed=1, B=4, beam_size=3,
@@ -386,10 +386,12 @@ def test_early_exit_actually_exits():
     p["decode"][fc]["bias"] = jnp.asarray(bias)
 
     full = jax.jit(
-        lambda c: beam_search(p, cfg, c, EOS, early_exit=False)
+        lambda c: beam_search(p, cfg, c, EOS, early_exit=False,
+                              return_steps=True)
     )
     fast = jax.jit(
-        lambda c: beam_search(p, cfg, c, EOS, early_exit=True)
+        lambda c: beam_search(p, cfg, c, EOS, early_exit=True,
+                              return_steps=True)
     )
     rf = full(contexts)
     rx = fast(contexts)
@@ -397,6 +399,12 @@ def test_early_exit_actually_exits():
     # beam 0 completes at step 0; the other fin slots fill at step 1 —
     # nothing survives past two tokens when eos dominates
     assert int(np.asarray(rx.lengths).max()) <= 2
+
+    # the deterministic signal: the control runs all 40 iterations, the
+    # exited program stops as soon as every image is sealed (~2 steps;
+    # ≤4 leaves margin for the one extra cond evaluation per fill step)
+    assert int(np.asarray(rf.steps_run)) == 40
+    assert int(np.asarray(rx.steps_run)) <= 4, int(np.asarray(rx.steps_run))
 
     def steady(fn):
         jax.block_until_ready(fn(contexts))
@@ -407,4 +415,10 @@ def test_early_exit_actually_exits():
         return time.perf_counter() - t0
 
     t_full, t_fast = steady(full), steady(fast)
-    assert t_fast < t_full / 2, (t_fast, t_full)
+    if t_fast >= t_full / 2:  # advisory: report, don't flake
+        import warnings
+
+        warnings.warn(
+            f"early-exit wall-clock advisory: fast={t_fast:.3f}s "
+            f"full={t_full:.3f}s (deterministic steps_run check passed)"
+        )

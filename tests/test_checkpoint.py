@@ -436,6 +436,37 @@ def test_async_writer_surfaces_write_failure(tmp_path, rng):
         w.close()
 
 
+def test_async_writer_failure_is_permanent(tmp_path, rng):
+    """Once a write fails, EVERY subsequent save/close re-raises — a
+    failure raised from save() must not be cleared so that close()
+    reports success (ADVICE r3: the old code popped _error on read)."""
+    import pytest
+
+    from sat_tpu.train.checkpoint import AsyncCheckpointWriter
+
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    bad = _tiny_config(save_dir=str(blocker / "sub"))
+    good = _tiny_config(save_dir=str(tmp_path / "ok"))
+    state = create_train_state(jax.random.PRNGKey(0), bad)
+
+    w = AsyncCheckpointWriter()
+    w.save(state, bad)
+    # wait for the worker to consume the doomed item and record the error
+    import time
+
+    for _ in range(100):
+        if w._error is not None:
+            break
+        time.sleep(0.05)
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        w.save(state, good)  # surfaced here first...
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        w.save(state, good)  # ...and permanently thereafter
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        w.close()
+
+
 def test_train_loop_async_checkpoints_restore(coco_fixture, tmp_path):
     """runtime.train with async_checkpoint on: periodic + final saves all
     land, and the final checkpoint restores to the final step."""
