@@ -32,7 +32,7 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_attempted = False
 
 
-ABI_VERSION = 3  # must match sat_native_abi_version() in api.cc
+ABI_VERSION = 4  # must match sat_native_abi_version() in api.cc
 
 
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -180,12 +180,29 @@ def stem(word: str) -> str:
     return _take_string(lib, ptr)
 
 
+# the C++ aligner's reference coverage mask capacity (kMaxRefWords in
+# meteor.cc); longer references would silently truncate there, so the
+# wrappers refuse them — sat_tpu.evalcap.meteor.meteor_single routes
+# such segments to the Python twin instead
+METEOR_MAX_REF_WORDS = 128
+
+
+def _check_ref_len(ref_tokens: str) -> None:
+    if len(ref_tokens.split()) > METEOR_MAX_REF_WORDS:
+        raise ValueError(
+            f"native METEOR caps references at {METEOR_MAX_REF_WORDS} "
+            "words; use sat_tpu.evalcap.meteor (the Python twin) for "
+            "longer segments"
+        )
+
+
 def meteor_segment(hyp_tokens: str, ref_tokens: str) -> float:
     """METEOR for one (hypothesis, reference) pair of space-joined
     token strings."""
     lib = get_lib()
     if lib is None:
         raise RuntimeError("native library unavailable")
+    _check_ref_len(ref_tokens)
     return float(
         lib.sat_meteor_segment(hyp_tokens.encode("utf-8"), ref_tokens.encode("utf-8"))
     )
@@ -196,6 +213,8 @@ def meteor_multi(hyp_tokens: str, ref_tokens: Sequence[str]) -> float:
     lib = get_lib()
     if lib is None:
         raise RuntimeError("native library unavailable")
+    for r in ref_tokens:
+        _check_ref_len(r)
     refs = (ctypes.c_char_p * len(ref_tokens))(
         *[r.encode("utf-8") for r in ref_tokens]
     )

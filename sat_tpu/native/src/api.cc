@@ -88,6 +88,6 @@ double sat_meteor_multi(const char* hyp, const char** refs, int n) {
 
 void sat_free(char* p) { std::free(p); }
 
-int sat_native_abi_version() { return 3; }
+int sat_native_abi_version() { return 4; }
 
 }  // extern "C"

@@ -3,15 +3,19 @@
 // pycocoevalcap/meteor/meteor.py:15-58).
 //
 // Mirror of the Python implementation in sat_tpu/evalcap/meteor.py
-// (golden-tested against it): stage-wise greedy alignment — exact (1.0),
-// Porter-stem (0.6), synonym (0.8) with nearest-occurrence pairing,
-// paraphrase phrase spans (0.6, longest-hyp-span-first) — and METEOR 1.5
-// scoring with the English rank-tuned parameters α=0.85, β=0.2, γ=0.6,
-// δ=0.75 (Denkowski & Lavie 2014): content/function-word discounted P
-// and R (per-side coverage, so paraphrase spans of unequal length score
-// correctly), fragmentation penalty only when the alignment has more
-// than one chunk.  The function-word, synonym, and paraphrase tables are
-// pushed in from Python (meteor_data.py) via sat_meteor_set_data so both
+// (golden-tested against it): joint alignment resolution over all
+// matcher candidates — exact (1.0), Porter-stem (0.6), synonym (0.8),
+// paraphrase phrase spans (0.6) — beam-searched to select the
+// non-overlapping subset that (1) maximizes covered words across both
+// sentences, (2) minimizes chunks, (3) minimizes summed start-position
+// distance (Denkowski & Lavie 2014 §3, the jar's Aligner.resolve; beam
+// width 40 like the jar, exhaustive at caption lengths) — and METEOR
+// 1.5 scoring with the English rank-tuned parameters α=0.85, β=0.2,
+// γ=0.6, δ=0.75: content/function-word discounted P and R (per-side
+// coverage, so paraphrase spans of unequal length score correctly),
+// fragmentation penalty only when the alignment has more than one
+// chunk.  The function-word, synonym, and paraphrase tables are pushed
+// in from Python (meteor_data.py) via sat_meteor_set_data so both
 // backends share one source of truth.
 
 #include <algorithm>
@@ -64,34 +68,6 @@ struct Match {
   double weight;
 };
 
-void run_key_stage(const std::vector<std::string>& hyp_keys,
-                   const std::vector<std::string>& ref_keys,
-                   std::vector<bool>* hyp_used, std::vector<bool>* ref_used,
-                   double weight, std::vector<Match>* matches,
-                   std::vector<double>* hyp_w, std::vector<double>* ref_w) {
-  std::map<std::string, std::vector<int>> ref_slots;
-  for (int j = 0; j < static_cast<int>(ref_keys.size()); j++) {
-    if (!(*ref_used)[j]) ref_slots[ref_keys[j]].push_back(j);
-  }
-  for (int i = 0; i < static_cast<int>(hyp_keys.size()); i++) {
-    if ((*hyp_used)[i]) continue;
-    auto it = ref_slots.find(hyp_keys[i]);
-    if (it == ref_slots.end() || it->second.empty()) continue;
-    // nearest remaining reference occurrence to position i
-    auto& slots = it->second;
-    auto best = std::min_element(
-        slots.begin(), slots.end(),
-        [i](int a, int b) { return std::abs(a - i) < std::abs(b - i); });
-    int j = *best;
-    slots.erase(best);
-    (*hyp_used)[i] = true;
-    (*ref_used)[j] = true;
-    matches->push_back({i, j, weight});
-    (*hyp_w)[i] = weight;
-    (*ref_w)[j] = weight;
-  }
-}
-
 bool share_group(const std::vector<int>& a, const std::vector<int>& b) {
   for (int ga : a)
     for (int gb : b)
@@ -99,34 +75,258 @@ bool share_group(const std::vector<int>& a, const std::vector<int>& b) {
   return false;
 }
 
-void run_synonym_stage(const std::vector<std::string>& hyp,
+// Beam width of the alignment resolution — the jar's default; mirrors
+// ALIGN_BEAM in sat_tpu/evalcap/meteor.py.
+constexpr int kAlignBeam = 40;
+// Reference-side coverage mask capacity.  PTB-tokenized captions run
+// well under this; sat_tpu.evalcap.meteor.meteor_single routes longer
+// segments to the Python twin (whose mask is an unbounded int).
+constexpr int kMaxRefWords = 128;
+
+struct Mask {
+  uint64_t lo = 0, hi = 0;
+  bool test(int j) const {
+    return j < 64 ? (lo >> j) & 1u : (hi >> (j - 64)) & 1u;
+  }
+  void set(int j) {
+    if (j < 64)
+      lo |= (uint64_t{1} << j);
+    else
+      hi |= (uint64_t{1} << (j - 64));
+  }
+  bool overlaps(const Mask& o) const {
+    return (lo & o.lo) != 0 || (hi & o.hi) != 0;
+  }
+  bool operator==(const Mask& o) const { return lo == o.lo && hi == o.hi; }
+};
+
+struct State {
+  int covered = 0;
+  int chunks = 0;
+  int dist = 0;
+  double weight = 0.0;
+  Mask mask;
+  int li = -2, lj = -2;  // last zipped pair (run tail for chunk counting)
+  std::vector<Match> pairs;
+  std::vector<std::pair<int, double>> hcov, rcov;  // (word idx, weight)
+};
+
+// "a strictly better than b" under the resolution's lexicographic
+// objective — mirrors the Python key (-covered, chunks, dist, -weight,
+// pairs, hcov, rcov).  The pairs/coverage comparisons are deterministic
+// final tiebreaks: two optima can share identical pairs but differ in
+// per-side coverage (a 2→1 vs 1→2 paraphrase span at the same anchor),
+// which changes P/R — both backends must pick the same one.
+int cmp_idx_weight(const std::vector<std::pair<int, double>>& a,
+                   const std::vector<std::pair<int, double>>& b) {
+  size_t n = std::min(a.size(), b.size());
+  for (size_t k = 0; k < n; k++) {
+    if (a[k].first != b[k].first) return a[k].first < b[k].first ? -1 : 1;
+    if (a[k].second != b[k].second) return a[k].second < b[k].second ? -1 : 1;
+  }
+  if (a.size() != b.size()) return a.size() < b.size() ? -1 : 1;
+  return 0;
+}
+
+bool state_better(const State& a, const State& b) {
+  if (a.covered != b.covered) return a.covered > b.covered;
+  if (a.chunks != b.chunks) return a.chunks < b.chunks;
+  if (a.dist != b.dist) return a.dist < b.dist;
+  if (a.weight != b.weight) return a.weight > b.weight;
+  size_t n = std::min(a.pairs.size(), b.pairs.size());
+  for (size_t k = 0; k < n; k++) {
+    const Match &x = a.pairs[k], &y = b.pairs[k];
+    if (x.hyp_idx != y.hyp_idx) return x.hyp_idx < y.hyp_idx;
+    if (x.ref_idx != y.ref_idx) return x.ref_idx < y.ref_idx;
+    if (x.weight != y.weight) return x.weight < y.weight;
+  }
+  if (a.pairs.size() != b.pairs.size())
+    return a.pairs.size() < b.pairs.size();
+  int c = cmp_idx_weight(a.hcov, b.hcov);
+  if (c != 0) return c < 0;
+  return cmp_idx_weight(a.rcov, b.rcov) < 0;
+}
+
+struct WordCand {
+  int j;
+  double weight;
+};
+struct SpanCand {
+  int len_h;  // L
+  int j;
+  int len_r;  // M
+};
+
+std::string join_span(const std::vector<std::string>& words, int start,
+                      int len);
+
+// All matcher-generated candidates, jointly (mirror of Python
+// _candidates): word candidates take the highest-PRECEDENCE applicable
+// matcher's weight (exact > stem > synonym — module order, not weight
+// order); span candidates come from the paraphrase table, minus 1×1
+// duplicates of word candidates and identical phrases (both fully
+// served by exact word matches — see the Python twin's rationale).
+void build_candidates(const std::vector<std::string>& hyp,
+                      const std::vector<std::string>& ref,
+                      const std::vector<std::string>& hyp_stems,
+                      const std::vector<std::string>& ref_stems,
+                      std::vector<std::vector<WordCand>>* word_cands,
+                      std::vector<std::vector<SpanCand>>* span_cands) {
+  int nh = static_cast<int>(hyp.size());
+  int nr = std::min(static_cast<int>(ref.size()), kMaxRefWords);
+  word_cands->assign(nh, {});
+  span_cands->assign(nh, {});
+  for (int i = 0; i < nh; i++) {
+    auto hsyn = g_synonyms.find(hyp[i]);
+    for (int j = 0; j < nr; j++) {
+      if (hyp[i] == ref[j]) {
+        (*word_cands)[i].push_back({j, kExactWeight});
+      } else if (hyp_stems[i] == ref_stems[j]) {
+        (*word_cands)[i].push_back({j, kStemWeight});
+      } else if (hsyn != g_synonyms.end()) {
+        auto rsyn = g_synonyms.find(ref[j]);
+        if (rsyn != g_synonyms.end() &&
+            share_group(hsyn->second, rsyn->second)) {
+          (*word_cands)[i].push_back({j, kSynonymWeight});
+        }
+      }
+    }
+  }
+  // gid -> reference spans (j, M) carrying that group
+  std::unordered_map<int, std::vector<std::pair<int, int>>> ref_spans;
+  for (int M = 1; M <= g_max_paraphrase_len; M++) {
+    for (int j = 0; j + M <= nr; j++) {
+      auto it = g_paraphrases.find(join_span(ref, j, M));
+      if (it == g_paraphrases.end()) continue;
+      for (int gid : it->second) ref_spans[gid].push_back({j, M});
+    }
+  }
+  for (int L = 1; L <= g_max_paraphrase_len; L++) {
+    for (int i = 0; i + L <= nh; i++) {
+      auto it = g_paraphrases.find(join_span(hyp, i, L));
+      if (it == g_paraphrases.end()) continue;
+      std::unordered_set<int> seen;  // key = j * (kMaxRefWords+1) + M
+      for (int gid : it->second) {
+        auto rit = ref_spans.find(gid);
+        if (rit == ref_spans.end()) continue;
+        for (auto [j, M] : rit->second) {
+          int key = j * (kMaxRefWords + 1) + M;
+          if (seen.count(key)) continue;
+          if (L == 1 && M == 1) {
+            bool dup = false;
+            for (const auto& wc : (*word_cands)[i])
+              if (wc.j == j) dup = true;
+            if (dup) continue;
+          }
+          if (L == M) {
+            bool identical = true;
+            for (int k = 0; k < L && identical; k++)
+              identical = hyp[i + k] == ref[j + k];
+            if (identical) continue;
+          }
+          seen.insert(key);
+          (*span_cands)[i].push_back({L, j, M});
+        }
+      }
+    }
+  }
+}
+
+// Resolve the alignment by beam search over hypothesis positions
+// (mirror of the Python align(); see the module header for the
+// objective).  Fills matches / per-side coverage weights.
+void resolve_alignment(const std::vector<std::string>& hyp,
                        const std::vector<std::string>& ref,
-                       std::vector<bool>* hyp_used,
-                       std::vector<bool>* ref_used,
+                       const std::vector<std::string>& hyp_stems,
+                       const std::vector<std::string>& ref_stems,
                        std::vector<Match>* matches,
                        std::vector<double>* hyp_w,
                        std::vector<double>* ref_w) {
-  for (int i = 0; i < static_cast<int>(hyp.size()); i++) {
-    if ((*hyp_used)[i]) continue;
-    auto hit = g_synonyms.find(hyp[i]);
-    if (hit == g_synonyms.end()) continue;
-    int best_j = -1;
-    for (int j = 0; j < static_cast<int>(ref.size()); j++) {
-      if ((*ref_used)[j]) continue;
-      auto rit = g_synonyms.find(ref[j]);
-      if (rit == g_synonyms.end()) continue;
-      if (share_group(hit->second, rit->second)) {
-        if (best_j < 0 || std::abs(j - i) < std::abs(best_j - i)) best_j = j;
+  std::vector<std::vector<WordCand>> word_cands;
+  std::vector<std::vector<SpanCand>> span_cands;
+  build_candidates(hyp, ref, hyp_stems, ref_stems, &word_cands, &span_cands);
+
+  int nh = static_cast<int>(hyp.size());
+  std::vector<std::vector<State>> pools(nh + 1);
+  pools[0].push_back(State{});
+
+  for (int pos = 0; pos < nh; pos++) {
+    auto pool = std::move(pools[pos]);
+    pools[pos].clear();
+    if (pool.empty()) continue;
+    // dedup on (mask, run tail): states identical there extend
+    // identically — keep the best-scored representative
+    std::map<std::tuple<uint64_t, uint64_t, int, int>, size_t> best_by;
+    std::vector<State> kept;
+    for (auto& st : pool) {
+      auto k = std::make_tuple(st.mask.lo, st.mask.hi, st.li, st.lj);
+      auto it = best_by.find(k);
+      if (it == best_by.end()) {
+        best_by[k] = kept.size();
+        kept.push_back(std::move(st));
+      } else if (state_better(st, kept[it->second])) {
+        kept[it->second] = std::move(st);
       }
     }
-    if (best_j >= 0) {
-      (*hyp_used)[i] = true;
-      (*ref_used)[best_j] = true;
-      matches->push_back({i, best_j, kSynonymWeight});
-      (*hyp_w)[i] = kSynonymWeight;
-      (*ref_w)[best_j] = kSynonymWeight;
+    std::sort(kept.begin(), kept.end(), state_better);
+    if (static_cast<int>(kept.size()) > kAlignBeam) kept.resize(kAlignBeam);
+
+    for (const auto& st : kept) {
+      // option: leave hyp word `pos` uncovered
+      pools[pos + 1].push_back(st);
+      for (const auto& wc : word_cands[pos]) {
+        if (st.mask.test(wc.j)) continue;
+        State nx = st;
+        bool adj = pos == st.li + 1 && wc.j == st.lj + 1;
+        nx.covered += 2;
+        nx.chunks += adj ? 0 : 1;
+        nx.dist += std::abs(pos - wc.j);
+        nx.weight += wc.weight;
+        nx.mask.set(wc.j);
+        nx.li = pos;
+        nx.lj = wc.j;
+        nx.pairs.push_back({pos, wc.j, wc.weight});
+        nx.hcov.push_back({pos, wc.weight});
+        nx.rcov.push_back({wc.j, wc.weight});
+        pools[pos + 1].push_back(std::move(nx));
+      }
+      for (const auto& sc : span_cands[pos]) {
+        Mask span_mask;
+        for (int k = 0; k < sc.len_r; k++) span_mask.set(sc.j + k);
+        if (st.mask.overlaps(span_mask)) continue;
+        int z = std::min(sc.len_h, sc.len_r);
+        State nx = st;
+        bool adj = pos == st.li + 1 && sc.j == st.lj + 1;
+        nx.covered += sc.len_h + sc.len_r;
+        nx.chunks += adj ? 0 : 1;
+        nx.dist += std::abs(pos - sc.j);
+        nx.weight += z * kParaphraseWeight;
+        nx.mask.lo |= span_mask.lo;
+        nx.mask.hi |= span_mask.hi;
+        nx.li = pos + z - 1;
+        nx.lj = sc.j + z - 1;
+        for (int k = 0; k < z; k++)
+          nx.pairs.push_back({pos + k, sc.j + k, kParaphraseWeight});
+        for (int k = 0; k < sc.len_h; k++)
+          nx.hcov.push_back({pos + k, kParaphraseWeight});
+        for (int k = 0; k < sc.len_r; k++)
+          nx.rcov.push_back({sc.j + k, kParaphraseWeight});
+        pools[pos + sc.len_h].push_back(std::move(nx));
+      }
     }
   }
+
+  const State* best = nullptr;
+  for (const auto& st : pools[nh]) {
+    if (best == nullptr || state_better(st, *best)) best = &st;
+  }
+  matches->clear();
+  hyp_w->assign(hyp.size(), -1.0);
+  ref_w->assign(ref.size(), -1.0);
+  if (best == nullptr) return;
+  *matches = best->pairs;
+  for (const auto& [idx, w] : best->hcov) (*hyp_w)[idx] = w;
+  for (const auto& [idx, w] : best->rcov) (*ref_w)[idx] = w;
 }
 
 std::string join_span(const std::vector<std::string>& words, int start,
@@ -137,59 +337,6 @@ std::string join_span(const std::vector<std::string>& words, int start,
     out += words[start + k];
   }
   return out;
-}
-
-// Paraphrase stage: longest unmatched hypothesis span first (leftmost
-// within a length); reference candidate = nearest unmatched span sharing
-// a group id, longer spans preferred on distance ties (mirrors the
-// Python iteration order exactly).  Covered words get per-side weight;
-// zipped word pairs feed the chunk count.
-void run_paraphrase_stage(const std::vector<std::string>& hyp,
-                          const std::vector<std::string>& ref,
-                          std::vector<bool>* hyp_used,
-                          std::vector<bool>* ref_used,
-                          std::vector<Match>* matches,
-                          std::vector<double>* hyp_w,
-                          std::vector<double>* ref_w) {
-  auto span_free = [](const std::vector<bool>& used, int start, int len) {
-    for (int k = 0; k < len; k++)
-      if (used[start + k]) return false;
-    return true;
-  };
-  for (int L = g_max_paraphrase_len; L >= 1; L--) {
-    for (int i = 0; i + L <= static_cast<int>(hyp.size()); i++) {
-      if (!span_free(*hyp_used, i, L)) continue;
-      auto hit = g_paraphrases.find(join_span(hyp, i, L));
-      if (hit == g_paraphrases.end()) continue;
-      int best_j = -1, best_m = 0, best_d = 0;
-      for (int M = g_max_paraphrase_len; M >= 1; M--) {
-        for (int j = 0; j + M <= static_cast<int>(ref.size()); j++) {
-          if (!span_free(*ref_used, j, M)) continue;
-          auto rit = g_paraphrases.find(join_span(ref, j, M));
-          if (rit == g_paraphrases.end()) continue;
-          if (!share_group(hit->second, rit->second)) continue;
-          int d = std::abs(j - i);
-          if (best_j < 0 || d < best_d) {
-            best_j = j;
-            best_m = M;
-            best_d = d;
-          }
-        }
-      }
-      if (best_j < 0) continue;
-      for (int k = 0; k < L; k++) {
-        (*hyp_used)[i + k] = true;
-        (*hyp_w)[i + k] = kParaphraseWeight;
-      }
-      for (int k = 0; k < best_m; k++) {
-        (*ref_used)[best_j + k] = true;
-        (*ref_w)[best_j + k] = kParaphraseWeight;
-      }
-      for (int k = 0; k < std::min(L, best_m); k++) {
-        matches->push_back({i + k, best_j + k, kParaphraseWeight});
-      }
-    }
-  }
 }
 
 // δ-discounted weighted match fraction for one side (P or R) from the
@@ -262,12 +409,6 @@ double meteor_segment(const std::string& hypothesis,
   std::vector<std::string> ref = split_ws(reference);
   if (hyp.empty() || ref.empty()) return 0.0;
 
-  std::vector<bool> hyp_used(hyp.size(), false), ref_used(ref.size(), false);
-  std::vector<double> hyp_w(hyp.size(), -1.0), ref_w(ref.size(), -1.0);
-  std::vector<Match> matches;
-  run_key_stage(hyp, ref, &hyp_used, &ref_used, kExactWeight, &matches,
-                &hyp_w, &ref_w);
-
   std::vector<std::string> hyp_stems(hyp.size()), ref_stems(ref.size());
   // corpus scoring re-stems the same caption vocabulary across thousands
   // of segments; cache stems (safe: the ctypes layer serializes scoring)
@@ -284,12 +425,10 @@ double meteor_segment(const std::string& hypothesis,
   };
   for (size_t i = 0; i < hyp.size(); i++) hyp_stems[i] = cached_stem(hyp[i]);
   for (size_t j = 0; j < ref.size(); j++) ref_stems[j] = cached_stem(ref[j]);
-  run_key_stage(hyp_stems, ref_stems, &hyp_used, &ref_used, kStemWeight,
-                &matches, &hyp_w, &ref_w);
 
-  run_synonym_stage(hyp, ref, &hyp_used, &ref_used, &matches, &hyp_w, &ref_w);
-  run_paraphrase_stage(hyp, ref, &hyp_used, &ref_used, &matches, &hyp_w,
-                       &ref_w);
+  std::vector<double> hyp_w, ref_w;
+  std::vector<Match> matches;
+  resolve_alignment(hyp, ref, hyp_stems, ref_stems, &matches, &hyp_w, &ref_w);
 
   if (matches.empty()) return 0.0;
   std::sort(matches.begin(), matches.end(),

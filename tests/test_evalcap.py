@@ -291,13 +291,16 @@ class TestMeteorGoldenFixtures:
             (0.75 * 0.6 + 0.25 * 1.0) / 1.0,
             None,  # Fmean computed from P,R below
         ),
-        # stage ordering: running~runs matches at the STEM stage (before
-        # the paraphrase stage can claim 'is running'~'runs'), leaving
-        # 'is' unmatched → 2 chunks
+        # joint resolution (Denkowski & Lavie 2014 §3): the paraphrase
+        # span 'is running'~'runs' covers 3 words where the stem match
+        # running~runs covers 2, so the resolver prefers it (criterion 2,
+        # maximize covered words) — every word matched, one chunk;
+        # m = (4 hyp + 3 ref)/2.  P: hyp a(1.0) man(1.0) is(.6) run-
+        # ning(.6), content man+running; R: ref a(1.0) man(1.0) runs(.6)
         (
             "a man is running",
             "a man runs",
-            3.0, 2.0, (0.75 * 1.6 + 0.25 * 1.0) / 2.0,
+            3.5, 1.0, (0.75 * 1.6 + 0.25 * 1.6) / 2.0,
             (0.75 * 1.6 + 0.25 * 1.0) / 1.75,
             None,
         ),
@@ -400,3 +403,133 @@ class TestMeteorGoldenFixtures:
         # (mean 0.287 / max 0.686 when recorded; bands allow table edits)
         assert 0.15 < delta < 0.45, f"corpus-mean table delta drifted: {delta}"
         assert 0.5 < max_seg < 0.8, f"max per-segment table delta drifted: {max_seg}"
+
+
+class TestMeteorAlignmentResolution:
+    """Pin the aligner's chunk-count behavior itself, not just the scoring
+    formula (VERDICT r03 weak #5 / next-round #5).
+
+    METEOR 1.5 resolves the alignment as the non-overlapping candidate
+    subset that (1) maximizes covered words, (2) minimizes chunks,
+    (3) minimizes summed start distances (Denkowski & Lavie 2014 §3).
+    The production beam aligner (width 40) is asserted EQUAL to an
+    exhaustive brute-force resolver under that exact objective on
+    adversarial fixtures where rounds 2-3's greedy stand-in
+    over-fragmented: crossing matches, repeated words, permuted phrases,
+    and span-vs-word tradeoffs.  Both backends are pinned.
+    """
+
+    # (name, hypothesis, reference)
+    CASES = [
+        ("crossing", "the dog chased the cat", "the cat chased the dog"),
+        ("repeated", "a man and a man", "a man a man and"),
+        ("permuted_phrase", "on the mat sat the cat", "the cat sat on the mat"),
+        ("swap_pair", "red blue", "blue red"),
+        ("interleave", "a b c a b c", "c b a c b a"),
+        ("dup_nearest_trap", "x a a x", "a x x a"),
+        ("offset_dup", "a b a b a", "b a b a b"),
+        ("span_vs_word", "a man is running", "a man runs"),
+        ("unequal_span", "a hot dog", "a frankfurter"),
+        ("stem_cross", "dogs dog", "dog dogs"),
+        ("syn_repeat", "a hound and a hound", "a dog and a dog"),
+    ]
+
+    @staticmethod
+    def _brute_force(hyp, ref):
+        """Exhaustive resolution under the published objective; returns
+        (covered, chunks, dist, weight) of the optimum."""
+        from sat_tpu.evalcap.meteor import PARAPHRASE_WEIGHT, _candidates
+
+        word_cands, span_cands = _candidates(hyp, ref)
+        best = [None]
+
+        def key(cov, ch, d, w):
+            return (-cov, ch, d, -w)
+
+        def rec(pos, mask, li, lj, cov, ch, d, w):
+            if pos == len(hyp):
+                k = key(cov, ch, d, w)
+                if best[0] is None or k < best[0]:
+                    best[0] = k
+                return
+            rec(pos + 1, mask, li, lj, cov, ch, d, w)
+            for j, pw in word_cands[pos]:
+                if mask & (1 << j):
+                    continue
+                adj = pos == li + 1 and j == lj + 1
+                rec(pos + 1, mask | (1 << j), pos, j, cov + 2,
+                    ch + (0 if adj else 1), d + abs(pos - j), w + pw)
+            for L, j, M in span_cands[pos]:
+                sm = ((1 << M) - 1) << j
+                if mask & sm:
+                    continue
+                z = min(L, M)
+                adj = pos == li + 1 and j == lj + 1
+                rec(pos + L, mask | sm, pos + z - 1, j + z - 1,
+                    cov + L + M, ch + (0 if adj else 1), d + abs(pos - j),
+                    w + z * PARAPHRASE_WEIGHT)
+
+        rec(0, 0, -2, -2, 0, 0, 0, 0.0)
+        cov, ch, d, w = best[0]
+        return -cov, ch, d, -w
+
+    @pytest.mark.parametrize(
+        "case", CASES, ids=[c[0] for c in CASES]
+    )
+    def test_beam_equals_brute_force(self, case):
+        from sat_tpu.evalcap.meteor import _chunks, align
+
+        _, h, r = case
+        hyp, ref = h.split(), r.split()
+        pairs, hyp_matched, ref_matched = align(hyp, ref)
+        covered = len(hyp_matched) + len(ref_matched)
+        chunks = _chunks(pairs)
+        want_cov, want_ch, _, _ = self._brute_force(hyp, ref)
+        assert covered == want_cov, (case[0], covered, want_cov)
+        assert chunks == want_ch, (case[0], chunks, want_ch)
+
+    @pytest.mark.parametrize(
+        "case", CASES, ids=[c[0] for c in CASES]
+    )
+    def test_backends_agree_on_adversarial_cases(self, case):
+        from sat_tpu import native
+        from sat_tpu.evalcap.meteor import score_from_stats, segment_stats
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        _, h, r = case
+        want = score_from_stats(segment_stats(h, r))
+        assert native.meteor_segment(h, r) == pytest.approx(
+            want, abs=1e-12
+        ), case[0]
+
+    def test_permuted_sentence_chunk_counts(self):
+        """Golden chunk counts on the permutation cases the greedy
+        stand-in got wrong (VERDICT r03 weak #5 named these): the shifted
+        repetition has ONE chunk (the whole overlap is a single run) and
+        the crossing sentence three."""
+        from sat_tpu.evalcap.meteor import _chunks, align
+
+        pairs, _, _ = align("a b a b a".split(), "b a b a b".split())
+        assert _chunks(pairs) == 1
+        pairs, _, _ = align(
+            "the dog chased the cat".split(), "the cat chased the dog".split()
+        )
+        assert _chunks(pairs) == 3
+
+    def test_native_refuses_over_cap_references(self):
+        """The C++ mask caps references at 128 words; the ctypes wrappers
+        must refuse longer ones (meteor_single routes them to the Python
+        twin) rather than silently truncating recall."""
+        from sat_tpu import native
+        from sat_tpu.evalcap.meteor import meteor_single
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        long_ref = " ".join(f"w{i}" for i in range(150))
+        with pytest.raises(ValueError, match="128"):
+            native.meteor_segment("w0 w1", long_ref)
+        with pytest.raises(ValueError, match="128"):
+            native.meteor_multi("w0 w1", [long_ref])
+        # the public scorer path still works — Python twin handles it
+        assert 0.0 < meteor_single("w0 w1", [long_ref]) < 1.0
