@@ -8,7 +8,7 @@ collectives; multi-host bootstrap wraps `jax.distributed.initialize`
 (the equivalent of the reference's TF_CONFIG/PS_HOSTS env plumbing).
 """
 
-from .mesh import make_mesh, initialize_distributed, mesh_from_devices
+from .mesh import make_mesh, initialize_distributed, mesh_from_devices, sync_processes
 from .sharding import (
     batch_sharding,
     param_partition_specs,
@@ -29,6 +29,7 @@ from .train import (
 
 __all__ = [
     "make_mesh",
+    "sync_processes",
     "mesh_from_devices",
     "initialize_distributed",
     "batch_sharding",

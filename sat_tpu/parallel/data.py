@@ -63,28 +63,80 @@ def mesh_data_shard(mesh) -> Tuple[int, int]:
     )
 
 
-def pad_dataset_for_processes(dataset: DataSet, process_count: int) -> DataSet:
-    """Pad an *unshuffled* eval/test DataSet to a count divisible by
-    ``process_count`` by repeating trailing rows, so every host's shard has
-    the same number of batches (a short shard would desynchronize the SPMD
-    decode collectives).  The padding rows are duplicates of real images;
-    result assembly cuts at the original count, mirroring the fake_count
-    convention (reference dataset.py:51-54)."""
-    pad = (-dataset.count) % process_count
-    if pad == 0:
-        return dataset
-    # modulo tiling: pad may exceed count (tiny dataset, many hosts)
-    idx = list(range(dataset.count)) + [i % dataset.count for i in range(pad)]
-    return DataSet(
-        dataset.image_ids[idx],
-        dataset.image_files[idx],
-        dataset.batch_size,
-        None if dataset.word_idxs is None else dataset.word_idxs[idx],
-        None if dataset.masks is None else dataset.masks[idx],
-        is_train=dataset.is_train,
-        shuffle=False,
-        seed=dataset.seed,
-    )
+class _ProcessShardView(DataSet):
+    """Per-process view of a global DataSet whose batch stream is
+    INVARIANT to the process layout.
+
+    Every epoch this view draws the GLOBAL keyed order — the permutation
+    and fake_count padding of DataSet._set_epoch, same key, same call
+    order — and takes the contiguous block of each global batch that this
+    process's data row owns.  make_global_batch places block ``r`` at the
+    global array's rows ``[r*Bl, (r+1)*Bl)``, so the assembled global
+    batch is element-for-element the batch a single-process run feeds at
+    the same (seed, epoch, step).  Two properties follow:
+
+    * loss parity: an N-process run computes each step's loss over the
+      exact example set (and row order) of the single-process run — the
+      multihost demo asserts it end to end;
+    * elastic resume: a run checkpointed under one process count and
+      resumed under another replays the same global batch stream
+      (the cursor is f(seed, epoch) exactly as on one process).
+
+    The global fake_count padding is part of the order, so every shard
+    always holds whole local batches and the synchronous step count
+    agrees across hosts with no truncation or process padding.
+    """
+
+    def __init__(self, global_ds: DataSet, shard_index: int, shard_count: int):
+        self._global_batch = global_ds.batch_size
+        self._shard_index = shard_index
+        self._shard_count = shard_count
+        super().__init__(
+            global_ds.image_ids,
+            global_ds.image_files,
+            global_ds.batch_size // shard_count,
+            global_ds.word_idxs,
+            global_ds.masks,
+            is_train=global_ds.is_train,
+            shuffle=global_ds.shuffle,
+            seed=global_ds.seed,
+        )
+
+    def setup(self) -> None:
+        # count / num_batches / fake_count describe the GLOBAL set (the
+        # step count every host must agree on); batch_size is local
+        self.count = len(self.image_ids)
+        self.num_batches = int(np.ceil(self.count / self._global_batch))
+        self.fake_count = self.num_batches * self._global_batch - self.count
+        self.epoch = -1
+        self._pending_seek = False
+        self.seek(0, 0)
+
+    def _set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        rng = np.random.default_rng((self.seed, epoch))
+        order = (
+            list(rng.permutation(self.count))
+            if self.shuffle
+            else list(range(self.count))
+        )
+        if self.fake_count:
+            order += list(rng.choice(self.count, self.fake_count))
+        B, Bl, r = self._global_batch, self.batch_size, self._shard_index
+        self.idxs = [
+            order[b * B + r * Bl + k]
+            for b in range(self.num_batches)
+            for k in range(Bl)
+        ]
+        self._pad_idxs = []  # padding is part of the global order above
+
+    # the local sequence is always whole batches (len(idxs) =
+    # num_batches * local batch) — iterate it, not the global count
+    def has_next_batch(self) -> bool:
+        return self.current_idx < len(self.idxs)
+
+    def has_full_next_batch(self) -> bool:
+        return self.current_idx + self.batch_size <= len(self.idxs)
 
 
 def process_local_dataset(
@@ -92,13 +144,12 @@ def process_local_dataset(
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
 ) -> DataSet:
-    """Slice a *global* DataSet down to this process's shard.
-
-    Rows ``process_index::process_count`` with a per-host batch size of
-    ``global_batch // process_count``; every host sees the same number of
-    batches so the synchronous step count agrees across the slice.
-    Single-process runs return the dataset unchanged.
-    """
+    """This process's view of a *global* DataSet: ``global_batch /
+    process_count`` items per step, each step's items being the contiguous
+    block of the global batch the process's data row owns
+    (:class:`_ProcessShardView` — the global batch stream is invariant to
+    the process layout).  Single-process runs return the dataset
+    unchanged."""
     pi = jax.process_index() if process_index is None else process_index
     pc = jax.process_count() if process_count is None else process_count
     if pc == 1:
@@ -108,21 +159,4 @@ def process_local_dataset(
             f"global batch {dataset.batch_size} not divisible by "
             f"{pc} processes"
         )
-    # Truncate every shard to the common length: unequal shards would give
-    # hosts different num_batches, desynchronizing the SPMD collectives
-    # (one host in the checkpoint all-gather while others are in the
-    # gradient all-reduce ⇒ hang).  Drops at most pc-1 trailing samples.
-    n = (len(dataset.image_ids) // pc) * pc
-    sel = slice(pi, n, pc)
-    return DataSet(
-        dataset.image_ids[sel],
-        dataset.image_files[sel],
-        dataset.batch_size // pc,
-        None if dataset.word_idxs is None else dataset.word_idxs[sel],
-        None if dataset.masks is None else dataset.masks[sel],
-        is_train=dataset.is_train,
-        shuffle=dataset.shuffle,
-        # decorrelated per-shard shuffle, still keyed on the run's base
-        # seed so config.seed controls the full multi-host batch stream
-        seed=dataset.seed * 1009 + pi,
-    )
+    return _ProcessShardView(dataset, pi, pc)

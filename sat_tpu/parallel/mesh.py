@@ -71,7 +71,38 @@ def initialize_distributed(
         num_processes=num_processes,
         process_id=process_id,
     )
+    if jax.process_count() > 1:
+        # Establish the cross-process collective context NOW, while every
+        # process is still at the bootstrap line.  The first collective
+        # creates it inside a fixed ~30s peer-connect window; deferred to
+        # first real use (e.g. device_put's cross-host assert_equal) the
+        # processes may arrive minutes apart — data prep and compilation
+        # are unsynchronized, and on an oversubscribed host (1 core, N
+        # workers) the stagger routinely exceeds the window, failing the
+        # whole cluster at its first collective.  Formed here it persists
+        # for the life of the process, and a genuinely broken cluster
+        # fails fast at bootstrap instead of mid-training.
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("sat_tpu:bootstrap")
     return True
+
+
+def sync_processes(tag: str) -> None:
+    """Cross-process barrier (no-op on single-process runs).
+
+    Placed immediately before phases whose FIRST collective creates a new
+    communicator (sharded device_put's cross-host assert_equal, a fresh
+    executable's collectives): the communicator rendezvous has a fixed
+    ~30s peer-connect window, while the host work separating two
+    collective phases (data prep, cache loads, image IO) is
+    unsynchronized and can drift processes apart by more than that on an
+    oversubscribed host.  The barrier itself reuses the context formed at
+    bootstrap, so it realigns the processes to ~0 drift at no risk."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
 
 
 def mesh_from_devices(
