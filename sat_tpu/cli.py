@@ -57,7 +57,10 @@ def build_config(argv: Optional[List[str]] = None):
         prog="sat_tpu",
         description="TPU-native Show, Attend and Tell",
     )
-    p.add_argument("--phase", default="train", choices=["train", "eval", "test"])
+    p.add_argument(
+        "--phase", default=None, choices=["train", "eval", "test"],
+        help="default: train, or the --config file's phase when one is given",
+    )
     p.add_argument(
         "--load", action="store_true",
         help="resume from the latest checkpoint in save_dir",
@@ -75,7 +78,13 @@ def build_config(argv: Optional[List[str]] = None):
         "--train_cnn", action="store_true",
         help="jointly train CNN + RNN (default: RNN only)",
     )
-    p.add_argument("--beam_size", type=int, default=3)
+    p.add_argument("--beam_size", type=int, default=None)
+    p.add_argument(
+        "--config", default=None, metavar="JSON",
+        help="load a Config JSON (e.g. the save_dir sidecar a checkpoint "
+             "rode with) as the base instead of built-in defaults; "
+             "--set/--phase still override it",
+    )
     p.add_argument(
         "--sweep", action="store_true",
         help="eval phase: score EVERY checkpoint under save_dir "
@@ -91,19 +100,29 @@ def build_config(argv: Optional[List[str]] = None):
              "--set stacks and env path re-rooting without running)",
     )
     args = p.parse_args(argv)
-    if args.sweep and args.phase != "eval":
-        raise SystemExit("--sweep only applies to --phase=eval")
     if args.sweep and (args.model_file or args.load):
         raise SystemExit(
             "--sweep scores every checkpoint under save_dir; it conflicts "
             "with --model_file/--load"
         )
 
-    config = Config(
-        phase=args.phase,
-        train_cnn=args.train_cnn,
-        beam_size=args.beam_size,
-    )
+    if args.config:
+        # file values are the base; only EXPLICIT flags override them
+        # (each flag's absent-default is a sentinel; train_cnn is a
+        # store_true — absent means "keep the file's value")
+        config = Config.load(args.config)
+        if args.phase is not None:
+            config = config.replace(phase=args.phase)
+        if args.train_cnn:
+            config = config.replace(train_cnn=True)
+        if args.beam_size is not None:
+            config = config.replace(beam_size=args.beam_size)
+    else:
+        config = Config(
+            phase=args.phase if args.phase is not None else "train",
+            train_cnn=args.train_cnn,
+            beam_size=args.beam_size if args.beam_size is not None else 3,
+        )
     overrides = {}
     for item in args.set:
         if "=" not in item:
@@ -116,6 +135,10 @@ def build_config(argv: Optional[List[str]] = None):
     # env-driven path re-rooting (SAT_DATA_ROOT / SAT_LOG_ROOT); explicit
     # --set overrides win because re-rooting only touches default values
     config = config.apply_env_paths()
+    # checked against the RESOLVED phase so `--sweep --config <eval cfg>`
+    # works without restating --phase
+    if args.sweep and config.phase != "eval":
+        raise SystemExit("--sweep only applies to --phase=eval")
 
     cli = {
         "load": args.load,
