@@ -333,6 +333,8 @@ def run_bench() -> None:
         config = config.replace(remat_decoder=True)
     if os.environ.get("BENCH_REMAT_CNN") == "1":  # encoder-remat A/B (joint)
         config = config.replace(remat_cnn=True)
+    if "BENCH_CE_DTYPE" in os.environ:  # bf16-CE A/B (PERF.md MFU lever)
+        config = config.replace(ce_dtype=os.environ["BENCH_CE_DTYPE"])
 
     T = config.max_caption_length
 
@@ -497,7 +499,10 @@ def run_bench() -> None:
     # (BENCH_EVAL=0 disables.)
     if os.environ.get("BENCH_EVAL", "1") == "1":
         try:
-            from sat_tpu.ops.beam_search import beam_search_jit
+            from sat_tpu.utils.benchmarking import (
+                make_chained_decode,
+                time_decode_windows,
+            )
 
             log("eval decode: compiling encoder+beam program (beam=3)")
             eval_iters = int(os.environ.get("BENCH_EVAL_ITERS", "5"))
@@ -508,28 +513,15 @@ def run_bench() -> None:
             if state.batch_stats:
                 eval_variables["batch_stats"] = state.batch_stats
 
-            @jax.jit
-            def decode(variables, images):
-                from sat_tpu.models.captioner import encode
-
-                contexts, _ = encode(variables, config, images, train=False)
-                out = beam_search_jit(
-                    variables["params"]["decoder"], config, contexts, 1, beam_size=3
-                )
-                # serializing dependency for chained timing (PERF.md)
-                return out, images + 1e-30 * out.log_scores.sum()
-
-            t_c = time.perf_counter()
-            out, images_c = decode(eval_variables, batch["images"])
-            jax.device_get(out.log_scores[0, 0])
-            log(f"eval decode compiled+first in {time.perf_counter() - t_c:.1f}s")
-            t0 = time.perf_counter()
-            for _ in range(eval_iters):
-                out, images_c = decode(eval_variables, images_c)
-            jax.device_get(out.log_scores[0, 0])
-            eval_elapsed = time.perf_counter() - t0
-            result["eval_images_per_sec"] = round(eval_iters * B / eval_elapsed, 2)
-            result["eval_batch_ms"] = round(1e3 * eval_elapsed / eval_iters, 1)
+            # the SAME measurement core as scripts/bench_eval{,_ab}.py —
+            # cross-vehicle deltas are process state, never harness drift
+            decode = make_chained_decode(config, eos=1, beam_size=3)
+            compile_s, windows_ms, _ = time_decode_windows(
+                decode, eval_variables, batch["images"], eval_iters, windows=1
+            )
+            log(f"eval decode compiled+first in {compile_s:.1f}s")
+            result["eval_images_per_sec"] = round(1e3 * B / windows_ms[0], 2)
+            result["eval_batch_ms"] = round(windows_ms[0], 1)
             log(f"eval decode: {result['eval_images_per_sec']} images/sec @ beam=3")
             print(json.dumps(result), flush=True)
         except Exception as e:  # pragma: no cover - additive metric only

@@ -138,6 +138,14 @@ class Config:
     # forward for the activation footprint that otherwise caps joint-
     # training batch size.  Numerically identical; off by default.
     remat_cnn: bool = False
+    # Cross-entropy/log-softmax dtype over the [B,T,vocab] logits.
+    # "float32" (default) materializes the fp32 log-softmax exactly as the
+    # reference's sparse_softmax_cross_entropy does; "bfloat16" keeps the
+    # [B,T,V] intermediates in bf16 (halving their HBM traffic — at
+    # B=128 the fp32 logp alone is ~51 MB/step) and accumulates the
+    # softmax normalizer in fp32.  Off by default pending a measured win
+    # (same policy as the remat knobs).
+    ce_dtype: str = "float32"
     mesh_shape: Tuple[int, ...] = (1, 1)   # (data, model) device mesh
     mesh_axes: Tuple[str, ...] = ("data", "model")
     context_parallel: int = 1          # shard the context grid over 'model'
@@ -176,6 +184,7 @@ class Config:
             ("num_attend_layers", (1, 2)),
             ("num_decode_layers", (1, 2)),
             ("rng_impl", ("threefry2x32", "rbg", "unsafe_rbg")),
+            ("ce_dtype", ("float32", "bfloat16")),
         )
         for name, allowed in checks:
             if getattr(self, name) not in allowed:

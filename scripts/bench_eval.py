@@ -15,7 +15,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -70,8 +69,7 @@ def main() -> int:
     import jax
 
     from sat_tpu.config import Config
-    from sat_tpu.models.captioner import encode, init_variables
-    from sat_tpu.ops.beam_search import beam_search_jit
+    from sat_tpu.models.captioner import init_variables
 
     dev = jax.devices()[0]
     print(f"device: {getattr(dev, 'device_kind', dev.platform)}", file=sys.stderr, flush=True)
@@ -123,34 +121,21 @@ def main() -> int:
     else:
         variables = init_variables(jax.random.PRNGKey(0), config)
 
-    @jax.jit
-    def decode(variables, images):
-        contexts, _ = encode(variables, config, images, train=False)
-        out = beam_search_jit(
-            variables["params"]["decoder"], config, contexts, eos,
-            beam_size=args.beam, valid_size=valid_size,
-            early_exit=not args.no_early_exit,
-        )
-        # serializing dependency for chained timing: a score-derived term
-        # too small to perturb fp32 image pixels (block_until_ready on
-        # independent dispatches is not trustworthy on the tunneled
-        # platform — see PERF.md methodology note)
-        chained = images + 1e-30 * out.log_scores.sum()
-        return out, chained
+    from sat_tpu.utils.benchmarking import (
+        make_chained_decode,
+        time_decode_windows,
+    )
 
-    t0 = time.perf_counter()
-    out, images_c = decode(variables, images)
-    jax.device_get(out.log_scores[0, 0])
-    compile_s = time.perf_counter() - t0
+    decode = make_chained_decode(
+        config, eos=eos, beam_size=args.beam, valid_size=valid_size,
+        early_exit=not args.no_early_exit,
+    )
+    compile_s, windows_ms, _ = time_decode_windows(
+        decode, variables, images, args.iters, windows=1
+    )
     print(f"compile+first: {compile_s:.1f}s", file=sys.stderr, flush=True)
 
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        out, images_c = decode(variables, images_c)
-    jax.device_get(out.log_scores[0, 0])
-    elapsed = time.perf_counter() - t0
-
-    images_per_sec = args.iters * B / elapsed
+    images_per_sec = 1e3 * B / windows_ms[0]
     print(
         json.dumps(
             {
@@ -158,7 +143,7 @@ def main() -> int:
                 "value": round(images_per_sec, 2),
                 "unit": f"images/sec @ beam={args.beam}",
                 "batch_size": B,
-                "batch_ms": round(1e3 * elapsed / args.iters, 1),
+                "batch_ms": round(windows_ms[0], 1),
                 "early_exit": not args.no_early_exit,
                 "device_kind": getattr(dev, "device_kind", dev.platform),
             }

@@ -25,7 +25,7 @@ GAVE_UP=""
 # RETRY_STAGES / RETRY_STAGE_CMD / RETRY_PROBE_CMD exist so the
 # give-up/artifact bookkeeping is testable without a device
 # (tests/test_bench.py); production runs never set them.
-ORDER=${RETRY_STAGES:-"bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 pallas profile bench_early_exit"}
+ORDER=${RETRY_STAGES:-"bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 bench_ce_bf16 bench_eval_ab pallas profile bench_early_exit"}
 
 stage_cmd() {
   if [ -n "${RETRY_STAGE_CMD:-}" ]; then echo "$RETRY_STAGE_CMD"; return; fi
@@ -35,6 +35,9 @@ stage_cmd() {
     bench_remat_cnn_joint) echo "env BENCH_TRAIN_CNN=1 BENCH_REMAT_CNN=1 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
     bench_resnet50)       echo "env BENCH_CNN=resnet50 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
     bench_B256)           echo "env BENCH_BATCH=256 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
+    bench_ce_bf16)        echo "env BENCH_CE_DTYPE=bfloat16 BENCH_BATCH=128 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
+    # outer timeout > sum of internal budgets: 6 arms (3 repeats x 2) x 420
+    bench_eval_ab)        echo "timeout 2600 python scripts/bench_eval_ab.py --budget-s 420" ;;
     pallas)               echo "timeout 500 python scripts/bench_pallas.py" ;;
     profile)              echo "timeout 900 bash scripts/profile_trace.sh $OUT" ;;
     # outer timeout > sum of the script's internal budgets (300+700+2*400)

@@ -49,7 +49,8 @@ echo "=== stage 1c: A/B knobs (dropout PRNG, decoder/encoder remat, resnet50) ==
 for label in "rng_threefry BENCH_RNG_IMPL=threefry2x32" \
              "remat_decoder BENCH_REMAT=1" \
              "remat_cnn_joint BENCH_TRAIN_CNN=1 BENCH_REMAT_CNN=1" \
-             "resnet50 BENCH_CNN=resnet50"; do
+             "resnet50 BENCH_CNN=resnet50" \
+             "ce_bf16 BENCH_CE_DTYPE=bfloat16 BENCH_BATCH=128"; do
   name=${label%% *}; envs=${label#* }
   echo "--- $name ($envs) ---"
   env $envs BENCH_EVAL=0 BENCH_WATCHDOG_S=480 timeout 500 python bench.py \
@@ -59,6 +60,15 @@ for label in "rng_threefry BENCH_RNG_IMPL=threefry2x32" \
     echo "STAGE FAILED: bench_$name (rc=$rc)"; FAILED="$FAILED bench_$name"
   fi
 done
+
+echo "=== stage 1d: eval-throughput A/B (fresh vs train-resident process) ==="
+# outer timeout > sum of internal budgets: 6 arms x 420s
+timeout 2600 python scripts/bench_eval_ab.py --budget-s 420 \
+  --out "$OUT/bench_eval_ab.json" >/dev/null 2>"$OUT/bench_eval_ab.log"
+rc=$?
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_eval_ab.json" ]; then
+  echo "STAGE FAILED: bench_eval_ab (rc=$rc)"; FAILED="$FAILED bench_eval_ab"
+fi
 
 echo "=== stage 2: pallas attention measurement ==="
 timeout 500 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"

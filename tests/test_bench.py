@@ -154,3 +154,31 @@ def test_retry_unknown_stage_fails_stage_not_script(tmp_path):
     assert "giving up" in proc.stdout
     assert not (tmp_path / "bench_resnet5O.json").exists()
     assert "unknown stage" in (tmp_path / "bench_resnet5O.log").read_text()
+
+
+def test_eval_ab_emits_summary_contract(tmp_path):
+    """bench_eval_ab's parent: interleaved fresh/resident subprocess arms,
+    one summary JSON line with the per-arm means and the clean-process
+    number as `value` (the PERF.md 802-vs-620 discrepancy protocol)."""
+    import subprocess
+    import sys
+
+    out = tmp_path / "ab.json"
+    proc = subprocess.run(
+        [sys.executable, "scripts/bench_eval_ab.py", "--cpu",
+         "--image-size", "32", "--batch", "2", "--beam", "2",
+         "--iters", "1", "--windows", "2", "--steps", "1",
+         "--repeats", "1", "--budget-s", "300", "--out", str(out)],
+        capture_output=True, text=True, timeout=540,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    summary = json.loads(out.read_text())
+    assert summary["metric"] == "eval_images_per_sec"
+    assert summary["value"] == summary["fresh_mean"] > 0
+    assert summary["resident_mean"] > 0
+    assert summary["resident_over_fresh"] > 0
+    arms = sorted(r["arm"] for r in summary["rows"])
+    assert arms == ["fresh", "resident"]
+    for r in summary["rows"]:
+        assert len(r["windows_batch_ms"]) == 2

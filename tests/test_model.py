@@ -422,3 +422,84 @@ class TestActivityRegularization:
         # frozen CNN: the conv activity gate is train_cnn (utils/nn.py:23)
         f0, f1 = (loss("vgg16", s, train_cnn=False) for s in (0.0, 1e-3))
         np.testing.assert_allclose(f0, f1, rtol=1e-7)
+
+
+class TestCeDtype:
+    """config.ce_dtype="bfloat16": CE computed without materializing a
+    [B,T,V] fp32 log-softmax — bf16 max/shift/exp, fp32 normalizer
+    accumulation (the MFU lever named in VERDICT r03 weak #2)."""
+
+    def test_bf16_formulation_exact_in_fp32(self):
+        """With fp32 logits the two CE paths are the same mathematics —
+        the manual logsumexp formulation must match log_softmax
+        essentially bitwise, grads included."""
+        base = tiny_config(fc_drop_rate=0.3, lstm_drop_rate=0.2)
+        bf = base.replace(ce_dtype="bfloat16")
+        batch = tiny_contexts_batch(base)
+        variables = init_variables(jax.random.PRNGKey(0), base)
+        key = jax.random.key(5, impl=base.rng_impl)
+
+        def loss_fn(cfg):
+            def f(v):
+                total, aux = compute_loss(v, cfg, batch, rng=key, train=True)
+                return total, aux["metrics"]["cross_entropy_loss"]
+            return jax.jit(jax.value_and_grad(f, has_aux=True))
+
+        (l0, ce0), g0 = loss_fn(base)(variables)
+        (l1, ce1), g1 = loss_fn(bf)(variables)
+        assert float(ce0) == pytest.approx(float(ce1), rel=1e-6)
+        assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+            ),
+            g0, g1,
+        )
+
+    def test_bf16_ce_close_under_bf16_compute(self):
+        """Under compute_dtype=bfloat16 (the TPU flagship), the bf16 CE
+        tracks the fp32-materializing path within bf16 resolution and the
+        gradients stay aligned."""
+        base = tiny_config(compute_dtype="bfloat16")
+        bf = base.replace(ce_dtype="bfloat16")
+        batch = tiny_contexts_batch(base)
+        variables = init_variables(jax.random.PRNGKey(0), base)
+        key = jax.random.key(5, impl=base.rng_impl)
+
+        def loss_fn(cfg):
+            def f(v):
+                total, _ = compute_loss(v, cfg, batch, rng=key, train=True)
+                return total
+            return jax.jit(jax.value_and_grad(f))
+
+        l0, g0 = loss_fn(base)(variables)
+        l1, g1 = loss_fn(bf)(variables)
+        # bf16 exp/shift carry ~2^-8 relative error into the normalizer
+        assert float(l0) == pytest.approx(float(l1), rel=1e-2)
+        flat0 = jnp.concatenate([
+            jnp.ravel(x).astype(jnp.float32)
+            for x in jax.tree_util.tree_leaves(g0)
+        ])
+        flat1 = jnp.concatenate([
+            jnp.ravel(x).astype(jnp.float32)
+            for x in jax.tree_util.tree_leaves(g1)
+        ])
+        cos = jnp.dot(flat0, flat1) / (
+            jnp.linalg.norm(flat0) * jnp.linalg.norm(flat1)
+        )
+        assert float(cos) > 0.999, float(cos)
+
+    def test_eval_path_unaffected(self):
+        """ce_dtype only touches training: eval CE is gated on train=True
+        and stays the exact fp32 materialization."""
+        base = tiny_config()
+        bf = base.replace(ce_dtype="bfloat16")
+        batch = tiny_contexts_batch(base)
+        variables = init_variables(jax.random.PRNGKey(0), base)
+        l0, _ = compute_loss(variables, base, batch, train=False)
+        l1, _ = compute_loss(variables, bf, batch, train=False)
+        assert float(l0) == float(l1)
+
+    def test_config_rejects_bad_ce_dtype(self):
+        with pytest.raises(ValueError, match="ce_dtype"):
+            tiny_config(ce_dtype="float16")
