@@ -34,7 +34,6 @@ import json
 import os
 import subprocess
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -163,18 +162,24 @@ def main() -> int:
             # a wedged child (the tunneled-backend failure mode) must
             # produce the same structured error row as a nonzero exit,
             # not an uncaught traceback
-            print(json.dumps({
+            row = {
                 "error": "arm_timeout", "arm": arm, "repeat": rep,
                 "budget_s": args.budget_s,
                 "stderr": ((e.stderr or "")[-500:] if isinstance(
                     e.stderr, str) else ""),
-            }), flush=True)
+            }
+            # both streams: tpu_session.sh discards stdout, the retry
+            # artifact contract reads it — diagnostics must survive each
+            print(json.dumps(row), flush=True)
+            print(json.dumps(row), file=sys.stderr, flush=True)
             return 3
         if proc.returncode != 0:
-            print(json.dumps({
+            row = {
                 "error": "arm_failed", "arm": arm, "repeat": rep,
                 "rc": proc.returncode, "stderr": proc.stderr[-500:],
-            }), flush=True)
+            }
+            print(json.dumps(row), flush=True)
+            print(json.dumps(row), file=sys.stderr, flush=True)
             return 3
         row = json.loads(proc.stdout.strip().splitlines()[-1])
         row["repeat"] = rep

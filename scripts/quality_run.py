@@ -277,6 +277,12 @@ def main() -> int:
         help="write scores.json only; leave RESULTS.md untouched (for "
         "secondary-evidence runs, e.g. the resnet50 variant)",
     )
+    ap.add_argument(
+        "--extra-set", action="append", default=[], metavar="KEY=VALUE",
+        help="extra Config overrides appended AFTER the protocol defaults "
+        "(e.g. fc_drop_rate=0.0 for a saturation run — memorization-"
+        "protocol dropout caps teacher-forced accuracy)",
+    )
     args = ap.parse_args()
 
     if args.cpu:
@@ -340,6 +346,7 @@ def main() -> int:
         f"image_size={args.image_size}",
         f"cnn={args.cnn}",
     ]
+    overrides += args.extra_set    # caller overrides win (later --set)
     set_args = [x for o in overrides for x in ("--set", o)]
 
     train_flags = [] if args.frozen_cnn else ["--train_cnn"]

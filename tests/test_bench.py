@@ -169,7 +169,8 @@ def test_eval_ab_emits_summary_contract(tmp_path):
          "--image-size", "32", "--batch", "2", "--beam", "2",
          "--iters", "1", "--windows", "2", "--steps", "1",
          "--repeats", "1", "--budget-s", "300", "--out", str(out)],
-        capture_output=True, text=True, timeout=540,
+        # outer > sum of child budgets (2 arms x 300s), repo convention
+        capture_output=True, text=True, timeout=700,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert proc.returncode == 0, proc.stderr[-1500:]
