@@ -118,6 +118,35 @@ def encode(
     return contexts, new_state
 
 
+def token_ce(
+    logits: jnp.ndarray,
+    sentences: jnp.ndarray,
+    config: Config,
+    train: bool = True,
+) -> jnp.ndarray:
+    """Per-token cross-entropy [B, T] — the ONE implementation shared by
+    the single-device loss and the context-parallel twin
+    (parallel/context.py), so config.ce_dtype behaves identically on
+    every path.
+
+    ce_dtype="bfloat16" (train only): ce = logsumexp - target_logit
+    computed WITHOUT materializing a [B,T,V] fp32 log-softmax —
+    max/shift/exp stay in the logits' bf16 (halving that tensor's HBM
+    traffic) and only the V-axis normalizer sum accumulates in fp32,
+    where the precision actually matters.  Eval/metrics keep the exact
+    fp32 path."""
+    if config.ce_dtype == "bfloat16" and train:
+        m = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
+        s = jnp.sum(
+            jnp.exp(logits - m), axis=-1, dtype=jnp.float32
+        )  # [B,T] fp32 accumulation of bf16 exps
+        lse = m[..., 0].astype(jnp.float32) + jnp.log(s)
+        tgt = jnp.take_along_axis(logits, sentences[..., None], axis=-1)
+        return lse - tgt[..., 0].astype(jnp.float32)           # [B,T]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, sentences[..., None], axis=-1)[..., 0]
+
+
 def compute_loss(
     variables: Dict[str, Any],
     config: Config,
@@ -164,23 +193,7 @@ def compute_loss(
         logits, alphas = decoded
 
     # masked sparse softmax cross-entropy, summed / mask-sum (model.py:316-318)
-    if config.ce_dtype == "bfloat16" and train:
-        # bf16 CE with fp32 accumulation: ce = logsumexp - target_logit
-        # computed WITHOUT materializing a [B,T,V] fp32 log-softmax —
-        # max/shift/exp stay in the logits' bf16 (halving that tensor's
-        # HBM traffic) and only the V-axis normalizer sum accumulates in
-        # fp32, where the precision actually matters.  Eval/metrics keep
-        # the exact fp32 path.
-        m = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
-        s = jnp.sum(
-            jnp.exp(logits - m), axis=-1, dtype=jnp.float32
-        )  # [B,T] fp32 accumulation of bf16 exps
-        lse = m[..., 0].astype(jnp.float32) + jnp.log(s)
-        tgt = jnp.take_along_axis(logits, sentences[..., None], axis=-1)
-        ce = lse - tgt[..., 0].astype(jnp.float32)             # [B,T]
-    else:
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        ce = -jnp.take_along_axis(logp, sentences[..., None], axis=-1)[..., 0]  # [B,T]
+    ce = token_ce(logits, sentences, config, train)            # [B,T]
     mask_sum = masks.sum()
     cross_entropy_loss = (ce * masks).sum() / mask_sum
 

@@ -47,7 +47,7 @@ from ..ops.beam_search import BeamResult, run_search, tile_beams
 from ..train.step import TrainState, split_trainable
 from ..train.optimizer import make_optimizer
 from ..nn.layers import regularization_loss
-from ..models.captioner import encode
+from ..models.captioner import encode, token_ce
 
 AXIS = "model"  # the mesh axis the context grid shards over
 
@@ -390,8 +390,9 @@ def _cp_loss_body(
     alphas_local = alphas_local.transpose(1, 0, 2)  # [B, T, Nl]
 
     masks = masks.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ce = -jnp.take_along_axis(logp, sentences[..., None], axis=-1)[..., 0]
+    # shared per-token CE (models/captioner.py token_ce): config.ce_dtype
+    # applies identically here and on the single-device path
+    ce = token_ce(logits, sentences, config, train=train)
     # global normalization: batch is sharded over 'data'
     ce_sum = jax.lax.psum((ce * masks).sum(), "data")
     mask_sum = jax.lax.psum(masks.sum(), "data")

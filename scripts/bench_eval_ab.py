@@ -133,6 +133,13 @@ def run_arm(args) -> int:
     return 0
 
 
+def _emit_error(row: dict) -> None:
+    # both streams: tpu_session.sh discards stdout, the retry artifact
+    # contract reads it — diagnostics must survive each wrapper
+    print(json.dumps(row), flush=True)
+    print(json.dumps(row), file=sys.stderr, flush=True)
+
+
 def main() -> int:
     ap = build_parser()
     args = ap.parse_args()
@@ -162,24 +169,18 @@ def main() -> int:
             # a wedged child (the tunneled-backend failure mode) must
             # produce the same structured error row as a nonzero exit,
             # not an uncaught traceback
-            row = {
+            _emit_error({
                 "error": "arm_timeout", "arm": arm, "repeat": rep,
                 "budget_s": args.budget_s,
                 "stderr": ((e.stderr or "")[-500:] if isinstance(
                     e.stderr, str) else ""),
-            }
-            # both streams: tpu_session.sh discards stdout, the retry
-            # artifact contract reads it — diagnostics must survive each
-            print(json.dumps(row), flush=True)
-            print(json.dumps(row), file=sys.stderr, flush=True)
+            })
             return 3
         if proc.returncode != 0:
-            row = {
+            _emit_error({
                 "error": "arm_failed", "arm": arm, "repeat": rep,
                 "rc": proc.returncode, "stderr": proc.stderr[-500:],
-            }
-            print(json.dumps(row), flush=True)
-            print(json.dumps(row), file=sys.stderr, flush=True)
+            })
             return 3
         row = json.loads(proc.stdout.strip().splitlines()[-1])
         row["repeat"] = rep

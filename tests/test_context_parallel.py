@@ -365,3 +365,42 @@ def test_cp_caption_fn_end_to_end(rng):
     np.testing.assert_allclose(
         np.asarray(got_a.alphas), np.asarray(want.alphas), rtol=1e-4, atol=1e-6
     )
+
+
+def test_cp_ce_dtype_matches_single_device(rng):
+    """config.ce_dtype applies identically on the CP path (shared
+    token_ce): train-mode CP loss under ce_dtype=bfloat16 must equal the
+    single-device compute_loss under the same knob (fp32 compute here, so
+    the manual-logsumexp formulation is exact — the parity being pinned
+    is path-sharing, not rounding)."""
+    config = _cfg(
+        mesh_shape=(2, 4), ce_dtype="bfloat16",
+        fc_drop_rate=0.0, lstm_drop_rate=0.0,
+    )
+    mesh = make_mesh(config)
+    params = init_decoder_params(jax.random.PRNGKey(0), config)
+
+    B, T = 4, config.max_caption_length
+    N, D = config.num_ctx, config.dim_ctx
+    contexts = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+    sentences = jnp.asarray(
+        rng.integers(0, config.vocabulary_size, size=(B, T)).astype(np.int32)
+    )
+    masks = jnp.ones((B, T), jnp.float32)
+
+    cp_loss = make_context_parallel_loss(config, mesh, train=True)
+    total_cp, metrics_cp = cp_loss(
+        params, contexts, sentences, masks, jax.random.key(1, impl=config.rng_impl)
+    )
+
+    batch = {"contexts": contexts, "word_idxs": sentences, "masks": masks}
+    variables = {"params": {"cnn": {}, "decoder": params}}
+    _, aux = compute_loss(
+        variables, config, batch,
+        rng=jax.random.key(1, impl=config.rng_impl), train=True,
+    )
+    np.testing.assert_allclose(
+        float(metrics_cp["cross_entropy_loss"]),
+        float(aux["metrics"]["cross_entropy_loss"]),
+        rtol=1e-5,
+    )
