@@ -42,6 +42,27 @@ Known divergences from the jar, quantified in tests/test_evalcap.py:
   exact/stem.  The scoring formula itself is pinned to the published
   METEOR 1.5 equations by hand-derived golden fixtures in that same
   test class, on both backends.
+* candidate pruning (accepted deviation, ADVICE r04): ``_candidates``
+  drops two paraphrase-candidate classes the jar's matcher stage may
+  generate — 1×1 paraphrase spans duplicating a word match, and
+  identical phrase spans (same words both sides).  1×1 duplicates are
+  strictly dominated (same coverage, same chunk/distance geometry,
+  never more weight).  Identical phrase spans are NOT: a span pays one
+  start-distance where its word matches pay one per word, so it can win
+  the distance tiebreak at lower total match weight — i.e. a resolver
+  fed the unpruned set can return a lower-scoring alignment (measured:
+  'a man and a man' vs 'a man a man and', weight 3.4 vs 5.0 at equal
+  coverage and chunks, via the table phrase 'a man').  Production
+  prunes the span and keeps the higher-scoring word-match alignment;
+  whether the jar's paraphrase matcher even proposes identical spans is
+  not verifiable offline (the jar is a missing blob in the reference
+  and the environment has no egress), so the pruning is pinned as a
+  directional guarantee instead: coverage and chunks are always
+  identical to the unpruned optimum and the score is never lower
+  (tests/test_evalcap.py::TestMeteorAlignmentResolution::
+  test_candidate_pruning_never_lowers_the_score, with the divergent
+  fixture pinned exactly in
+  test_identical_span_pruning_changes_resolution_as_documented).
 """
 
 from __future__ import annotations

@@ -32,7 +32,7 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_attempted = False
 
 
-ABI_VERSION = 4  # must match sat_native_abi_version() in api.cc
+ABI_VERSION = 5  # must match sat_native_abi_version() in api.cc
 
 
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:

@@ -67,20 +67,25 @@ void sat_meteor_set_data(const char* function_words, const char* synsets,
 }
 
 // METEOR score of one hypothesis against one reference, both given as
-// space-joined token strings.
+// space-joined token strings.  Returns -1.0 if the reference exceeds
+// the aligner's 128-word coverage-mask capacity (scores live in [0,1]);
+// callers must treat negative as "unscorable here", not as a score.
 double sat_meteor_segment(const char* hyp, const char* ref) {
   if (hyp == nullptr || ref == nullptr) return 0.0;
   return sat_native::meteor_segment(hyp, ref);
 }
 
 // METEOR with multiple references: max over refs (jar behavior).
-// refs: array of n space-joined token strings.
+// refs: array of n space-joined token strings.  Returns -1.0 when any
+// reference is over the per-segment cap — skipping it would silently
+// change the max-over-refs semantics.
 double sat_meteor_multi(const char* hyp, const char** refs, int n) {
   if (hyp == nullptr || refs == nullptr) return 0.0;
   double best = 0.0;
   for (int i = 0; i < n; i++) {
     if (refs[i] == nullptr) continue;
     double s = sat_native::meteor_segment(hyp, refs[i]);
+    if (s < 0.0) return -1.0;
     if (s > best) best = s;
   }
   return best;
@@ -88,6 +93,6 @@ double sat_meteor_multi(const char* hyp, const char** refs, int n) {
 
 void sat_free(char* p) { std::free(p); }
 
-int sat_native_abi_version() { return 4; }
+int sat_native_abi_version() { return 5; }
 
 }  // extern "C"

@@ -80,7 +80,9 @@ bool share_group(const std::vector<int>& a, const std::vector<int>& b) {
 constexpr int kAlignBeam = 40;
 // Reference-side coverage mask capacity.  PTB-tokenized captions run
 // well under this; sat_tpu.evalcap.meteor.meteor_single routes longer
-// segments to the Python twin (whose mask is an unbounded int).
+// segments to the Python twin (whose mask is an unbounded int), and
+// meteor_segment returns the -1.0 sentinel for over-cap references so
+// a direct C ABI caller can never get a silently truncated score.
 constexpr int kMaxRefWords = 128;
 
 struct Mask {
@@ -408,6 +410,11 @@ double meteor_segment(const std::string& hypothesis,
   std::vector<std::string> hyp = split_ws(hypothesis);
   std::vector<std::string> ref = split_ws(reference);
   if (hyp.empty() || ref.empty()) return 0.0;
+  // Over-cap references cannot be represented in the coverage mask;
+  // refuse with a sentinel (scores live in [0,1]) instead of silently
+  // deflating recall by truncation (ADVICE r04) — the ctypes wrapper
+  // refuses earlier with a message, this guards direct C ABI callers.
+  if (static_cast<int>(ref.size()) > kMaxRefWords) return -1.0;
 
   std::vector<std::string> hyp_stems(hyp.size()), ref_stems(ref.size());
   // corpus scoring re-stems the same caption vocabulary across thousands

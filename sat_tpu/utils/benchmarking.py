@@ -74,5 +74,7 @@ def time_decode_windows(
         for _ in range(iters):
             out, images_c = decode(variables, images_c)
         jax.device_get(out.log_scores[0, 0])
-        windows_ms.append(round(1e3 * (time.perf_counter() - t0) / iters, 2))
+        # raw ms — callers derive images/sec from this, so rounding happens
+        # only at presentation/serialization time (ADVICE r04)
+        windows_ms.append(1e3 * (time.perf_counter() - t0) / iters)
     return compile_s, windows_ms, images_c

@@ -123,7 +123,7 @@ def run_arm(args) -> int:
         "arm": args.arm,
         "batch": B,
         "beam": args.beam,
-        "windows_batch_ms": windows_ms,
+        "windows_batch_ms": [round(ms, 2) for ms in windows_ms],
         "images_per_sec_last_window": round(1e3 * B / windows_ms[-1], 2),
         "compile_s": round(compile_s, 1),
         "device_kind": getattr(dev, "device_kind", dev.platform),

@@ -75,14 +75,9 @@ def main() -> int:
     )
     from sat_tpu.train.step import create_train_state
 
-    try:
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        jax.config.update(
-            "jax_compilation_cache_dir", os.path.join(repo, ".jax_compile_cache")
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception as e:
-        print(f"[import-ft] compilation cache not enabled: {e!r}")
+    from sat_tpu.utils.compile_cache import enable as _enable_cache
+
+    _enable_cache(jax)
 
     steps_per_epoch = -(-2 * args.num_images // args.batch_size)
 

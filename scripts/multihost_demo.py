@@ -32,8 +32,8 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
 # share the repo's persistent compile cache across workers/reruns
-jax.config.update("jax_compilation_cache_dir", os.path.join(repo, ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from sat_tpu.utils.compile_cache import enable as _enable_cache
+_enable_cache(jax, name=".jax_cache", root=repo, min_compile_time_secs=0.5)
 
 from sat_tpu.parallel import initialize_distributed
 initialize_distributed(
@@ -80,8 +80,8 @@ sys.path.insert(0, repo)
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", os.path.join(repo, ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from sat_tpu.utils.compile_cache import enable as _enable_cache
+_enable_cache(jax, name=".jax_cache", root=repo, min_compile_time_secs=0.5)
 
 from sat_tpu.config import Config
 config = Config.load(os.path.join(root, "config.json")).replace(

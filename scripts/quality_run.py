@@ -359,14 +359,9 @@ def main() -> int:
     # Persistent compilation cache (same dir as bench.py): the resnet50
     # CPU-XLA compile in particular runs tens of minutes cold on this
     # 1-core host; a rerun must not pay it twice.
-    try:
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        jax.config.update(
-            "jax_compilation_cache_dir", os.path.join(repo, ".jax_compile_cache")
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception as e:
-        print(f"[quality] compilation cache not enabled: {e!r}")
+    from sat_tpu.utils.compile_cache import enable as _enable_cache
+
+    _enable_cache(jax)
 
     device = jax.devices()[0]
     print(f"[quality +{time.time()-t0:5.1f}s] device: {device.device_kind} ({device.platform})")

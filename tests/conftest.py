@@ -22,13 +22,11 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the suite compiles dozens of model/mesh
 # variants; caching them across runs cuts wall-clock several-fold.
-_cache_dir = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    ".jax_cache",
-)
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# Machine-keyed so entries from another build box are never loaded (each
+# cross-machine load logs a multi-KB XLA:CPU feature-mismatch warning).
+from sat_tpu.utils.compile_cache import enable as _enable_cache  # noqa: E402
+
+_enable_cache(jax, name=".jax_cache", min_compile_time_secs=0.5)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

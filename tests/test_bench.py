@@ -40,6 +40,7 @@ def test_orchestrator_emits_error_json_when_budget_exhausted():
 
 def test_probe_round_trips_a_computation_on_cpu():
     env = dict(os.environ, BENCH_CPU="1", JAX_PLATFORMS="cpu")
+    env.pop("BENCH_PROBE_MICRO", None)
     proc = subprocess.run(
         [sys.executable, BENCH, "--probe"],
         env=env,
@@ -49,6 +50,67 @@ def test_probe_round_trips_a_computation_on_cpu():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "probe ok" in proc.stderr
+    # micro-bench defaults off on CPU: a smoke probe stays a fast liveness
+    # check and prints no metric line
+    assert not [l for l in proc.stdout.splitlines() if l.strip().startswith("{")]
+
+
+def test_probe_micro_emits_provisional_metric():
+    # VERDICT r04 weak #1 / next-round #7: a live probe window alone must
+    # land a parseable non-null metric, so a flapping tunnel that stays up
+    # ~60s still produces a non-null BENCH artifact.
+    env = dict(
+        os.environ,
+        BENCH_CPU="1",
+        JAX_PLATFORMS="cpu",
+        BENCH_PROBE_MICRO="1",
+        BENCH_BATCH="2",
+        BENCH_IMAGE_SIZE="32",
+    )
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--probe"],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip().startswith("{")]
+    assert len(lines) == 1, proc.stdout
+    parsed = json.loads(lines[0])
+    assert parsed["metric"] == "train_captions_per_sec"
+    assert parsed["value"] is not None and parsed["value"] > 0
+    assert parsed["window"] == "probe"
+
+
+def test_orchestrator_keeps_probe_metric_when_child_fails():
+    # The probe's provisional line must survive as a valid LAST JSON line:
+    # a child that keeps crashing (bogus BENCH_STEPS parses in the child
+    # only — the micro-bench doesn't read it) must neither retry forever
+    # nor append an error line after the metric.
+    env = dict(
+        os.environ,
+        BENCH_CPU="1",
+        JAX_PLATFORMS="cpu",
+        BENCH_PROBE_MICRO="1",
+        BENCH_BATCH="2",
+        BENCH_IMAGE_SIZE="32",
+        BENCH_STEPS="bogus",
+        BENCH_WATCHDOG_S="300",
+    )
+    proc = subprocess.run(
+        [sys.executable, BENCH],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=330,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip().startswith("{")]
+    assert lines, proc.stdout
+    parsed = json.loads(lines[-1])
+    assert parsed.get("error") is None
+    assert parsed["value"] is not None and parsed["window"] == "probe"
 
 
 def test_orchestrator_reports_deterministic_child_failure_as_bench_failed():

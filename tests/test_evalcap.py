@@ -435,12 +435,67 @@ class TestMeteorAlignmentResolution:
     ]
 
     @staticmethod
-    def _brute_force(hyp, ref):
+    def _candidates_unpruned(hyp, ref):
+        """Independent candidate enumerator WITHOUT the production pruning.
+
+        Re-derives the matcher candidate sets from the data tables alone,
+        keeping the two candidate classes production ``_candidates`` drops
+        (1×1 paraphrase duplicates of word matches; identical phrase
+        spans).  Exists so the oracle tests can detect a scoring effect of
+        the pruning itself, which reusing the production helper cannot
+        (ADVICE r04).
+        """
+        from sat_tpu.evalcap.meteor import (
+            EXACT_WEIGHT,
+            STEM_WEIGHT,
+            SYNONYM_WEIGHT,
+            _paraphrases,
+            _stem,
+            _synonyms,
+        )
+        from sat_tpu.evalcap.meteor_data import MAX_PARAPHRASE_LEN
+
+        syn = _synonyms()
+        para = _paraphrases()
+        word_cands = [[] for _ in hyp]
+        for i, h in enumerate(hyp):
+            h_stem, h_gids = _stem(h), syn.get(h)
+            for j, r in enumerate(ref):
+                if h == r:
+                    word_cands[i].append((j, EXACT_WEIGHT))
+                elif h_stem == _stem(r):
+                    word_cands[i].append((j, STEM_WEIGHT))
+                elif h_gids and syn.get(r) and (h_gids & syn[r]):
+                    word_cands[i].append((j, SYNONYM_WEIGHT))
+        span_cands = [[] for _ in hyp]
+        ref_spans = {}
+        for M in range(1, MAX_PARAPHRASE_LEN + 1):
+            for j in range(0, len(ref) - M + 1):
+                for gid in para.get(" ".join(ref[j:j + M]), ()):
+                    ref_spans.setdefault(gid, []).append((j, M))
+        for L in range(1, MAX_PARAPHRASE_LEN + 1):
+            for i in range(0, len(hyp) - L + 1):
+                gids = para.get(" ".join(hyp[i:i + L]))
+                if not gids:
+                    continue
+                seen = set()
+                for gid in gids:
+                    for j, M in ref_spans.get(gid, ()):
+                        if (j, M) not in seen:
+                            seen.add((j, M))
+                            span_cands[i].append((L, j, M))
+        return word_cands, span_cands
+
+    @classmethod
+    def _brute_force(cls, hyp, ref, unpruned=False):
         """Exhaustive resolution under the published objective; returns
         (covered, chunks, dist, weight) of the optimum."""
         from sat_tpu.evalcap.meteor import PARAPHRASE_WEIGHT, _candidates
 
-        word_cands, span_cands = _candidates(hyp, ref)
+        word_cands, span_cands = (
+            cls._candidates_unpruned(hyp, ref) if unpruned
+            else _candidates(hyp, ref)
+        )
         best = [None]
 
         def key(cov, ch, d, w):
@@ -488,6 +543,57 @@ class TestMeteorAlignmentResolution:
         assert covered == want_cov, (case[0], covered, want_cov)
         assert chunks == want_ch, (case[0], chunks, want_ch)
 
+    # Cases chosen to make the pruned candidate classes actually exist:
+    # 'hot dog' is a paraphrase-table phrase appearing verbatim on both
+    # sides (identical-span candidate), 'hotdog' a single table word
+    # matching exactly (1×1-duplicate candidate).
+    PRUNING_CASES = CASES + [
+        ("identical_phrase", "a hot dog", "a hot dog"),
+        ("identical_phrase_ctx", "i ate a hot dog now", "she had a hot dog today"),
+        ("one_by_one_dup", "a hotdog bun", "a hotdog bun"),
+    ]
+
+    @pytest.mark.parametrize(
+        "case", PRUNING_CASES, ids=[c[0] for c in PRUNING_CASES]
+    )
+    def test_candidate_pruning_never_lowers_the_score(self, case):
+        """Pin the scoring effect of the production candidate pruning
+        (1×1 paraphrase duplicates, identical phrase spans).
+
+        The other oracle tests reuse production ``_candidates``, so they
+        pin resolution but would miss a semantics change introduced by
+        the pruning itself (ADVICE r04).  This compares the exhaustive
+        optimum over the pruned set against the optimum over an
+        independently-enumerated UNPRUNED set, asserting the documented
+        deviation (meteor.py module header): coverage and chunk count
+        are always identical (so the fragmentation penalty is unchanged)
+        and the pruned optimum's total match weight is never lower (so
+        the segment score is never lower).  Equality is not asserted:
+        an identical phrase span CAN win the distance tiebreak with a
+        lower weight — the pruning exists precisely to keep the
+        higher-scoring word-match alignment in that situation.
+        """
+        _, h, r = case
+        hyp, ref = h.split(), r.split()
+        p_cov, p_ch, _, p_w = self._brute_force(hyp, ref)
+        u_cov, u_ch, _, u_w = self._brute_force(hyp, ref, unpruned=True)
+        assert (p_cov, p_ch) == (u_cov, u_ch), (case[0], (p_cov, p_ch), (u_cov, u_ch))
+        assert p_w >= u_w - 1e-12, (case[0], p_w, u_w)
+
+    def test_identical_span_pruning_changes_resolution_as_documented(self):
+        """The one fixture class where pruning is NOT resolution-neutral,
+        pinned exactly: in 'a man and a man' vs 'a man a man and', the
+        identical span 'a man'↔'a man' (a real paraphrase-table phrase)
+        pays ONE start-distance where its two word matches pay two, so
+        the unpruned resolver picks it on the distance tiebreak at lower
+        total weight — a lower segment score.  Production drops the span
+        and keeps the all-word alignment (weight 5.0 over 3.4)."""
+        hyp, ref = "a man and a man".split(), "a man a man and".split()
+        pruned = self._brute_force(hyp, ref)
+        unpruned = self._brute_force(hyp, ref, unpruned=True)
+        assert pruned == (10, 2, 12, 5.0), pruned
+        assert unpruned == (10, 2, 7, pytest.approx(3.4)), unpruned
+
     @pytest.mark.parametrize(
         "case", CASES, ids=[c[0] for c in CASES]
     )
@@ -533,3 +639,24 @@ class TestMeteorAlignmentResolution:
             native.meteor_multi("w0 w1", [long_ref])
         # the public scorer path still works — Python twin handles it
         assert 0.0 < meteor_single("w0 w1", [long_ref]) < 1.0
+
+    def test_c_abi_returns_sentinel_for_over_cap_references(self):
+        """A DIRECT C ABI caller (bypassing the ctypes wrappers) must get
+        the -1.0 sentinel for an over-cap reference, never a silently
+        truncated score (ADVICE r04); sat_meteor_multi propagates it
+        rather than skipping the reference (which would change the
+        max-over-refs semantics)."""
+        import ctypes
+
+        from sat_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        lib = native.get_lib()
+        long_ref = " ".join(f"w{i}" for i in range(150)).encode()
+        assert lib.sat_meteor_segment(b"w0 w1", long_ref) == -1.0
+        refs = (ctypes.c_char_p * 2)(b"w0 w1", long_ref)
+        assert lib.sat_meteor_multi(b"w0 w1", refs, 2) == -1.0
+        # at-cap references still score normally
+        at_cap = " ".join(f"w{i}" for i in range(128)).encode()
+        assert 0.0 < lib.sat_meteor_segment(b"w0 w1", at_cap) <= 1.0

@@ -692,9 +692,9 @@ def test_sigkill_and_cli_resume_bitwise_matches_control(coco_fixture, tmp_path):
         "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
         "import jax\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
-        f"jax.config.update('jax_compilation_cache_dir', {(repo + '/.jax_cache')!r})\n"
-        "jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)\n"
         f"sys.path.insert(0, {repo!r})\n"
+        "from sat_tpu.utils.compile_cache import enable as _enable_cache\n"
+        "_enable_cache(jax, name='.jax_cache', min_compile_time_secs=0.5)\n"
         "from sat_tpu import cli\n"
         "sys.exit(cli.main(sys.argv[1:]))\n"
     )
