@@ -94,9 +94,15 @@ rc=${PIPESTATUS[0]}
 
 echo "=== stage 4 (optional, TPU_SESSION_RICH=1): rich-corpus quality + import-finetune ==="
 if [ "${TPU_SESSION_RICH:-0}" = "1" ]; then
+  # 224px -> the full 196-position context grid (VERDICT r4 next-round
+  # #3): the 0.853 teacher-forced-accuracy plateau was localized to the
+  # tiny grid a frozen encoder exposes at CPU image sizes; dropout 0 is
+  # the saturation protocol (memorization-protocol dropout caps accuracy,
+  # RESULTS.md rich-corpus-r4).  Affordable only on the chip.
   timeout 3600 python scripts/quality_run.py --corpus rich --frozen-cnn \
-    --image-size 64 --batch-size 16 --steps 4000 --beam-compare \
-    --out runs/quality_rich 2>&1 | tee "$OUT/quality_rich.txt" | tail -15
+    --image-size 224 --batch-size 16 --steps 4000 --beam-compare \
+    --extra-set fc_drop_rate=0.0 --extra-set lstm_drop_rate=0.0 \
+    --out runs/quality_rich_224 2>&1 | tee "$OUT/quality_rich.txt" | tail -15
   rc=${PIPESTATUS[0]}
   [ "$rc" -ne 0 ] && { echo "STAGE FAILED: rich quality (rc=$rc)"; FAILED="$FAILED quality_rich"; }
   timeout 1800 python scripts/import_finetune_run.py 2>&1 \
