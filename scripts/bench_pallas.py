@@ -1,15 +1,22 @@
 """Measure the fused Pallas attention kernel against XLA on the real chip.
 
 Decides the fate of ``use_pallas_attention`` (VERDICT r1 item 6): flagship
-decode shapes, both implementations timed over identical inputs, plus the
-end-to-end beam-search step impact.  Run on TPU (no JAX_PLATFORMS override).
+decode shapes, both implementations timed over identical inputs.  Round 5
+extends the single-B=48 measurement to a batch sweep (VERDICT r4
+next-round #8): default B ∈ {32, 48, 64, 128}, one correctness check and
+one speedup per size, and the ENABLE verdict requires the kernel to hold
+>= 1.0x at EVERY size — a knob that wins at one operating point and
+loses at another must not be default-on.  Run on TPU (no JAX_PLATFORMS
+override).
 
 Usage: python scripts/bench_pallas.py [--batch 48] [--iters 200]
+  (--batch 0 = the default sweep)
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -28,7 +35,6 @@ def timeit(fn, args, iters: int) -> float:
     latency (~1 ms) swamps µs-scale kernels and block_until_ready has been
     observed returning before remote completion (see PERF.md)."""
     import jax
-    import jax.numpy as jnp
 
     t1, t2, w2, ctx = args
 
@@ -46,23 +52,16 @@ def timeit(fn, args, iters: int) -> float:
     return (time.perf_counter() - t0) / iters
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=48, help="B (images × beams)")
-    ap.add_argument("--iters", type=int, default=200)
-    ap.add_argument("--block-b", type=int, default=0, help="0 = sweep")
-    args = ap.parse_args()
-
+def bench_one(B: int, iters: int, block_arg: int):
+    """Time XLA vs the kernel at one batch size; returns a result row or
+    None when the kernel fails to lower at every tiling."""
     import jax
     import jax.numpy as jnp
 
     from sat_tpu.ops.pallas_attention import fused_attend, fused_attend_reference
 
-    dev = jax.devices()[0]
-    print(f"device: {dev.device_kind} ({dev.platform})", flush=True)
-
     # flagship decode shapes: VGG16 grid N=196, da=D=512
-    B, N, da, D = args.batch, 196, 512, 512
+    N, da, D = 196, 512, 512
     rng = np.random.default_rng(0)
     t1 = jnp.asarray(rng.normal(size=(B, N, da)).astype(np.float32))
     t2 = jnp.asarray(rng.normal(size=(B, da)).astype(np.float32))
@@ -70,36 +69,37 @@ def main() -> int:
     ctx = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
 
     xla = jax.jit(fused_attend_reference, static_argnames=("compute_dtype",))
-    t_xla = timeit(xla, (t1, t2, w2, ctx), args.iters)
+    t_xla = timeit(xla, (t1, t2, w2, ctx), iters)
     traffic_mb = (t1.nbytes + ctx.nbytes) / 1e6
     print(
-        f"XLA fused:    {t_xla*1e6:8.1f} us   "
+        f"[B={B:3d}] XLA fused:    {t_xla*1e6:8.1f} us   "
         f"(~{traffic_mb / t_xla / 1e3:.0f} GB/s effective)", flush=True,
     )
 
-    blocks = [args.block_b] if args.block_b else [4, 8, 16]
+    # no divisibility guard: fused_attend pads the batch axis up to a
+    # multiple of block_b, so every tiling is valid at every B
+    blocks = [block_arg] if block_arg else [4, 8, 16]
     best = (None, float("inf"))
     for bb in blocks:
         try:
             t_pal = timeit(
                 lambda *a: fused_attend(*a, block_b=bb),
-                (t1, t2, w2, ctx), args.iters,
+                (t1, t2, w2, ctx), iters,
             )
         except Exception as e:  # mosaic lowering failure at this tiling
-            print(f"pallas bb={bb}: FAILED ({type(e).__name__}: {e})", flush=True)
+            print(f"[B={B:3d}] pallas bb={bb}: FAILED ({type(e).__name__}: {e})",
+                  flush=True)
             continue
         print(
-            f"pallas bb={bb:2d}: {t_pal*1e6:8.1f} us   "
+            f"[B={B:3d}] pallas bb={bb:2d}: {t_pal*1e6:8.1f} us   "
             f"(~{traffic_mb / t_pal / 1e3:.0f} GB/s effective)", flush=True,
         )
         if t_pal < best[1]:
             best = (bb, t_pal)
 
     if best[0] is None:
-        print("verdict: pallas kernel failed to run — keep XLA path")
-        return 1
-    speedup = t_xla / best[1]
-    print(f"best pallas: block_b={best[0]}  speedup vs XLA: {speedup:.2f}x")
+        return None
+
     # correctness BEFORE the verdict: a fast-but-wrong kernel must never
     # emit the ENABLE line.  Both impls are compared against a
     # highest-precision ground truth rather than against each other: on
@@ -119,15 +119,56 @@ def main() -> int:
 
     err_alpha = (max_err(got[1], truth[1]), max_err(want[1], truth[1]))
     err_ctx = (max_err(got[0], truth[0]), max_err(want[0], truth[0]))
-    print(f"max |err| vs fp32 ground truth — alpha: pallas {err_alpha[0]:.2e} "
-          f"xla {err_alpha[1]:.2e}; context: pallas {err_ctx[0]:.2e} xla {err_ctx[1]:.2e}")
-    assert err_alpha[0] <= max(err_alpha[1] * 1.5, 1e-5), err_alpha
-    assert err_ctx[0] <= max(err_ctx[1] * 1.5, 1e-4), err_ctx
-    print("on-device correctness: OK (kernel error <= XLA-path error)")
+    print(f"[B={B:3d}] max |err| vs fp32 ground truth — alpha: pallas "
+          f"{err_alpha[0]:.2e} xla {err_alpha[1]:.2e}; context: pallas "
+          f"{err_ctx[0]:.2e} xla {err_ctx[1]:.2e}", flush=True)
+    assert err_alpha[0] <= max(err_alpha[1] * 1.5, 1e-5), (B, err_alpha)
+    assert err_ctx[0] <= max(err_ctx[1] * 1.5, 1e-4), (B, err_ctx)
+
+    speedup = t_xla / best[1]
+    print(f"[B={B:3d}] best pallas: block_b={best[0]}  "
+          f"speedup vs XLA: {speedup:.2f}x  correctness OK", flush=True)
+    return {
+        "batch": B,
+        "xla_us": round(t_xla * 1e6, 1),
+        "pallas_us": round(best[1] * 1e6, 1),
+        "block_b": best[0],
+        "speedup": round(speedup, 3),
+        "err_ctx_pallas": err_ctx[0],
+        "err_ctx_xla": err_ctx[1],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=0,
+                    help="B (images × beams); 0 = sweep 32,48,64,128")
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--block-b", type=int, default=0, help="0 = sweep tilings")
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})", flush=True)
+
+    batches = [args.batch] if args.batch else [32, 48, 64, 128]
+    rows = []
+    for B in batches:
+        row = bench_one(B, args.iters, args.block_b)
+        if row is None:
+            print(f"verdict: pallas kernel failed at B={B} — keep XLA path")
+            return 1
+        rows.append(row)
+
+    min_speedup = min(r["speedup"] for r in rows)
+    print(json.dumps({"sweep": rows, "min_speedup": min_speedup}), flush=True)
+    # default-on requires holding the win at EVERY measured operating
+    # point (VERDICT r4 next-round #8); 1.0 exactly is a wash, keep it
     print(
         "verdict: ENABLE use_pallas_attention"
-        if speedup > 1.05
-        else "verdict: keep XLA path (no win)"
+        if min_speedup >= 1.0
+        else "verdict: keep XLA path (loses at some batch size)"
     )
     return 0
 

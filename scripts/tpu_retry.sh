@@ -38,7 +38,9 @@ stage_cmd() {
     bench_ce_bf16)        echo "env BENCH_CE_DTYPE=bfloat16 BENCH_BATCH=128 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
     # outer timeout > sum of internal budgets: 6 arms (3 repeats x 2) x 420
     bench_eval_ab)        echo "timeout 2600 python scripts/bench_eval_ab.py --budget-s 420" ;;
-    pallas)               echo "timeout 500 python scripts/bench_pallas.py" ;;
+    # batch sweep (4 sizes x up-to-4 loop compiles each) needs more than
+    # the single-B budget
+    pallas)               echo "timeout 1800 python scripts/bench_pallas.py" ;;
     profile)              echo "timeout 900 bash scripts/profile_trace.sh $OUT" ;;
     # outer timeout > sum of the script's internal budgets (300+700+2*400)
     bench_early_exit)     echo "timeout 1900 bash scripts/bench_early_exit.sh $OUT" ;;

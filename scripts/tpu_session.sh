@@ -71,7 +71,7 @@ if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_eval_ab.json" ]; then
 fi
 
 echo "=== stage 2: pallas attention measurement ==="
-timeout 500 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
+timeout 1800 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
 rc=${PIPESTATUS[0]}
 [ "$rc" -ne 0 ] && { echo "STAGE FAILED: pallas (rc=$rc)"; FAILED="$FAILED pallas"; }
 
