@@ -86,6 +86,19 @@ def _error_line(error: str, **extras) -> str:
     return json.dumps(err)
 
 
+def _emit_degraded(state: dict, child_rc) -> None:
+    """Re-emit the last relayed (probe-provisional) metric line with
+    ``degraded=true`` + the bench child's rc, so a driver reading the
+    LAST JSON line sees BOTH a valid metric (value non-null, no ``error``
+    key — the contract) and a machine-readable record that the full bench
+    never completed (ADVICE r5 #2)."""
+    if not state["last_metric"]:
+        return  # a full-child line landed un-relayed; nothing to annotate
+    final = dict(state["last_metric"])
+    final.update(degraded=True, bench_child_rc=child_rc)
+    print(json.dumps(final), flush=True)
+
+
 def orchestrate() -> int:
     """Probe-retry-run loop inside the total BENCH_WATCHDOG_S budget.
 
@@ -100,7 +113,7 @@ def orchestrate() -> int:
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
     min_run_budget = 45.0  # don't bother starting a bench child with less
     script = os.path.abspath(__file__)
-    state = {"emitted": False, "attempts": 0, "probe_rc": None}
+    state = {"emitted": False, "attempts": 0, "probe_rc": None, "last_metric": None}
 
     # Last-resort self-deadline: a child stuck in uninterruptible kernel
     # sleep survives SIGKILL delivery until its syscall returns, which
@@ -158,6 +171,7 @@ def orchestrate() -> int:
                 print(pline, flush=True)
                 if parsed.get("value") is not None:
                     state["emitted"] = True
+                    state["last_metric"] = parsed
 
         # The micro-bench needs import + init + a possibly-cold 20-40s
         # compile inside the probe's own timeout; with a short window
@@ -233,8 +247,13 @@ def orchestrate() -> int:
                     # the probe's provisional metric already landed; an
                     # error line here would become the LAST JSON line and
                     # break the "first or last line is a valid metric"
-                    # contract
+                    # contract.  Re-emit it annotated instead: extra keys
+                    # keep the line a valid metric while recording
+                    # machine-readably that the full bench child failed —
+                    # a persistent bench bug must not masquerade as a
+                    # healthy run (ADVICE r5 #2).
                     log(f"bench child keeps failing rc={rc}; keeping probe metric")
+                    _emit_degraded(state, rc)
                     return 0
                 print(
                     _error_line(
@@ -254,7 +273,10 @@ def orchestrate() -> int:
     # failure either way.  If a probe-side provisional metric landed, the
     # artifact is already valid — don't append an error as the last line.
     if state["emitted"]:
+        # same degraded annotation as the fast-failure path: only the
+        # probe's provisional window landed, so the artifact must say so
         log("budget exhausted after provisional metric — done")
+        _emit_degraded(state, last_child_rc)
         return 0
     print(
         _error_line(

@@ -5,6 +5,11 @@ Flag-for-flag parity with the reference CLI (/root/reference/main.py:15-36):
 --beam_size``, dispatching to the runtime layer (main.py:45-72).  Any other
 Config field can be overridden with ``--set key=value`` pairs (the
 reference requires editing config.py for those).
+
+One extra input-pipeline flag beyond the reference surface:
+``--shard_cache auto|on|off`` selects the mmap'd preprocessed-shard
+cache (docs/DATA_PIPELINE.md); ``--set`` spellings of the same field
+still win, flag defaults never clobber them.
 """
 
 from __future__ import annotations
@@ -80,6 +85,13 @@ def build_config(argv: Optional[List[str]] = None):
     )
     p.add_argument("--beam_size", type=int, default=None)
     p.add_argument(
+        "--shard_cache", default=None, choices=["auto", "on", "off"],
+        help="preprocessed-image shard cache (data.shards): 'auto' "
+             "(default) uses a valid existing cache and falls back to "
+             "live JPEG decode otherwise, 'on' builds/extends the cache "
+             "before the run, 'off' forces live decode",
+    )
+    p.add_argument(
         "--config", default=None, metavar="JSON",
         help="load a Config JSON (e.g. the save_dir sidecar a checkpoint "
              "rode with) as the base instead of built-in defaults; "
@@ -123,6 +135,8 @@ def build_config(argv: Optional[List[str]] = None):
             train_cnn=args.train_cnn,
             beam_size=args.beam_size if args.beam_size is not None else 3,
         )
+    if args.shard_cache is not None:
+        config = config.replace(shard_cache=args.shard_cache)
     overrides = {}
     for item in args.set:
         if "=" not in item:

@@ -146,6 +146,17 @@ class Config:
     # softmax normalizer in fp32.  Off by default pending a measured win
     # (same policy as the remat knobs).
     ce_dtype: str = "float32"
+    # Preprocessed shard cache (data.shards): serve batches as mmap
+    # fancy-index gathers of post-resize uint8 tensors instead of running
+    # the JPEG codec every step — bitwise-identical to live decode, and
+    # the measured fix for the host-bound input pipeline (PERF.md "Host
+    # input pipeline").  "auto" (default): use a valid existing cache,
+    # else fall back to live decode; "on": build/extend the cache first
+    # (one-time decode cost), then serve from it; "off": always live
+    # decode.  Files missing from a cache fall back per image either way.
+    shard_cache: str = "auto"
+    shard_cache_dir: str = "./data/shards/"
+    shard_rows: int = 1024             # rows per shard file (~154 MB @224px)
     mesh_shape: Tuple[int, ...] = (1, 1)   # (data, model) device mesh
     mesh_axes: Tuple[str, ...] = ("data", "model")
     context_parallel: int = 1          # shard the context grid over 'model'
@@ -185,6 +196,7 @@ class Config:
             ("num_decode_layers", (1, 2)),
             ("rng_impl", ("threefry2x32", "rbg", "unsafe_rbg")),
             ("ce_dtype", ("float32", "bfloat16")),
+            ("shard_cache", ("auto", "on", "off")),
         )
         for name, allowed in checks:
             if getattr(self, name) not in allowed:
@@ -231,7 +243,7 @@ class Config:
     DATA_PATH_FIELDS = (
         "vocabulary_file", "train_image_dir", "train_caption_file",
         "temp_annotation_file", "temp_data_file", "eval_image_dir",
-        "eval_caption_file", "test_image_dir",
+        "eval_caption_file", "test_image_dir", "shard_cache_dir",
     )
     LOG_PATH_FIELDS = (
         "save_dir", "summary_dir", "profile_dir", "eval_result_dir",

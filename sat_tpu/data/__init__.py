@@ -7,6 +7,12 @@ from .dataset import (
     prepare_train_data,
 )
 from .images import ILSVRC_2012_MEAN, ImageLoader, PrefetchLoader
+from .shards import (
+    ShardCache,
+    ShardCacheMismatch,
+    build_shard_cache,
+    resolve_shard_cache,
+)
 from .tokenizer import PUNCTUATIONS, tokenize, tokenize_captions, tokenize_no_punct
 from .vocabulary import Vocabulary
 
@@ -16,6 +22,10 @@ __all__ = [
     "Vocabulary",
     "ImageLoader",
     "PrefetchLoader",
+    "ShardCache",
+    "ShardCacheMismatch",
+    "build_shard_cache",
+    "resolve_shard_cache",
     "ILSVRC_2012_MEAN",
     "PUNCTUATIONS",
     "tokenize",

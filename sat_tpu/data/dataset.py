@@ -9,6 +9,12 @@ caches and vocabulary build-or-load logic.
 
 The fixed batch size is deliberate: static shapes keep every XLA program
 compiled exactly once.
+
+A ``DataSet`` yields batch *file lists* only — image bytes are assembled
+downstream by ``PrefetchLoader`` (live decode or the mmap'd shard cache,
+see ``data.shards`` / docs/DATA_PIPELINE.md).  Keeping epoch order a pure
+function of ``(seed, epoch)`` is what lets the shard path inherit
+mid-epoch bitwise resume for free.
 """
 
 from __future__ import annotations

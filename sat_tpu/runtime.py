@@ -45,6 +45,59 @@ from .utils.summary import SummaryWriter
 
 
 # ---------------------------------------------------------------------------
+# input feed shared by all three phases
+# ---------------------------------------------------------------------------
+
+
+def make_loader(config: Config, dataset: DataSet) -> PrefetchLoader:
+    """The host-side input pipeline for a dataset: shard-cache resolution
+    (build-or-load per ``config.shard_cache``; falls back to live JPEG
+    decode when no valid cache exists — see data.shards) + the prefetching
+    batch assembler.  All three phase loops build their feed here so the
+    cache policy is applied uniformly."""
+    from .data.shards import resolve_shard_cache
+
+    return PrefetchLoader(
+        dataset,
+        ImageLoader(size=config.image_size, raw=config.device_preprocess),
+        num_workers=config.num_data_workers,
+        prefetch_depth=config.prefetch_depth,
+        shard_cache=resolve_shard_cache(config, dataset.image_files),
+    )
+
+
+def device_prefetch(loader, ahead: int = 1):
+    """Double-buffered host→device feed: dispatch batch k+1's transfer
+    before the consumer syncs on step k.
+
+    ``jax.device_put`` is asynchronous — it enqueues the host→HBM copy and
+    returns immediately — so holding ``ahead`` already-dispatched batches
+    in a ring overlaps every batch's transfer with the previous step's
+    device compute; the step dispatch then consumes an array that is
+    already (or almost) resident instead of paying the copy on its
+    critical path.  Array leaves are transferred; everything else
+    ('files') passes through.  Single-device feed only: the mesh paths
+    place batches through ``make_global_batch``, which owns its own
+    per-device placement.
+    """
+    from collections import deque
+
+    def put(batch):
+        return {
+            k: jax.device_put(v) if isinstance(v, np.ndarray) else v
+            for k, v in batch.items()
+        }
+
+    buf = deque()
+    for batch in loader:
+        buf.append(put(batch))
+        if len(buf) > ahead:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()
+
+
+# ---------------------------------------------------------------------------
 # state setup shared by all three phases
 # ---------------------------------------------------------------------------
 
@@ -211,15 +264,14 @@ def train(
             dataset, process_index=shard_idx, process_count=n_shards
         )
         place_batch = lambda b: make_global_batch(mesh, b)  # noqa: E731
+        wrap_feed = lambda l: l  # noqa: E731 — make_global_batch places
     else:
         train_step = make_jit_train_step(config)
         place_batch = lambda b: b  # noqa: E731
-    loader = PrefetchLoader(
-        dataset,
-        ImageLoader(size=config.image_size, raw=config.device_preprocess),
-        num_workers=config.num_data_workers,
-        prefetch_depth=config.prefetch_depth,
-    )
+        # async device slot: batch k+1's host→HBM transfer is dispatched
+        # while step k still runs, so the step never pays the copy
+        wrap_feed = device_prefetch
+    loader = make_loader(config, dataset)
     # Typed key with the configured bit-generator impl: dropout-mask
     # generation is ~40% of the flagship train step under threefry (the
     # decoder draws ~130M mask bits/step); config.rng_impl="rbg" routes it
@@ -277,7 +329,7 @@ def train(
                 desc=f"epoch {epoch + 1}/{config.num_epochs}",
                 initial=skip_batches if epoch == start_epoch else 0,
             )
-            for batch in loader:
+            for batch in wrap_feed(loader):
                 if config.max_steps and step >= config.max_steps:
                     stopped = True
                     break
@@ -413,12 +465,7 @@ def decode_dataset(
             local_ds = process_local_dataset(
                 dataset, process_index=shard_idx, process_count=n_shards
             )
-            loader = PrefetchLoader(
-                local_ds,
-                ImageLoader(size=config.image_size, raw=config.device_preprocess),
-                num_workers=config.num_data_workers,
-                prefetch_depth=config.prefetch_depth,
-            )
+            loader = make_loader(config, local_ds)
             from .utils.dist import gather_tree_replicated
 
             gathered = []
@@ -467,12 +514,7 @@ def decode_dataset(
                 return_alphas=config.save_attention_maps,
             )
 
-    loader = PrefetchLoader(
-        dataset,
-        ImageLoader(size=config.image_size, raw=config.device_preprocess),
-        num_workers=config.num_data_workers,
-        prefetch_depth=config.prefetch_depth,
-    )
+    loader = make_loader(config, dataset)
 
     results: List[Dict[str, Any]] = []
     seen = set()
@@ -520,11 +562,18 @@ def decode_dataset(
     # train's (shared ProfilerWindow), start clamped to the batch count so
     # a short eval still traces; the trace shows how much of the batch
     # time is the beam program vs encode vs dispatch
+    # single-device decode gets the async device slot too (the mesh paths
+    # place batches through make_global_batch inside run_batch)
+    feed = (
+        device_prefetch(loader)
+        if int(np.prod(config.mesh_shape)) == 1
+        else loader
+    )
     with ProfilerWindow(config, max_start=dataset.num_batches - 1) as prof:
         # per-batch visibility during decode (reference base_model.py:82,131
         # tqdm-bars eval/test; a full-COCO eval would otherwise run silent)
         for b, batch in enumerate(
-            track(loader, dataset.num_batches, desc="decode")
+            track(feed, dataset.num_batches, desc="decode")
         ):
             prof.before_step(b)
             out = run_batch(batch)                 # async dispatch

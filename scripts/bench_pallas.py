@@ -181,11 +181,13 @@ def main() -> int:
         print("smoke complete (interpret mode): no enable/keep verdict")
         return 0
     # default-on requires holding the win at EVERY measured operating
-    # point (VERDICT r4 next-round #8); 1.0 exactly is a wash, keep it
+    # point (VERDICT r4 next-round #8); 1.0 exactly is a wash, keep it —
+    # the 1.02 margin keeps run-to-run timing noise from flipping the
+    # default on a result indistinguishable from a wash (ADVICE r5 #1)
     print(
         "verdict: ENABLE use_pallas_attention"
-        if min_speedup >= 1.0
-        else "verdict: keep XLA path (loses at some batch size)"
+        if min_speedup >= 1.02
+        else "verdict: keep XLA path (wash or loses at some batch size)"
     )
     return 0
 
