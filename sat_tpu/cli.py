@@ -92,6 +92,27 @@ def build_config(argv: Optional[List[str]] = None):
              "before the run, 'off' forces live decode",
     )
     p.add_argument(
+        "--anomaly_policy", default=None,
+        choices=["off", "warn", "skip", "rollback"],
+        help="anomaly-sentinel response to NaN/Inf or spiking metrics at "
+             "each log_every check (docs/RESILIENCE.md): 'warn' (default) "
+             "reports and stops blessing LAST_GOOD, 'skip' also suppresses "
+             "checkpoint writes while unhealthy, 'rollback' restores "
+             "LAST_GOOD and fast-forwards past the poison step, 'off' "
+             "disarms the sentinel",
+    )
+    p.add_argument(
+        "--keep_checkpoints", type=int, default=None, metavar="N",
+        help="checkpoint retention: keep the newest N plus the LAST_GOOD "
+             "target, delete the rest (default 0 = keep everything)",
+    )
+    p.add_argument(
+        "--io_retries", type=int, default=None, metavar="N",
+        help="retry budget for transient IO errors (EIO/EAGAIN/ESTALE...) "
+             "on checkpoint/shard/manifest/caption reads and writes, with "
+             "jittered exponential backoff (default 3; 0 disables)",
+    )
+    p.add_argument(
         "--config", default=None, metavar="JSON",
         help="load a Config JSON (e.g. the save_dir sidecar a checkpoint "
              "rode with) as the base instead of built-in defaults; "
@@ -137,6 +158,12 @@ def build_config(argv: Optional[List[str]] = None):
         )
     if args.shard_cache is not None:
         config = config.replace(shard_cache=args.shard_cache)
+    if args.anomaly_policy is not None:
+        config = config.replace(anomaly_policy=args.anomaly_policy)
+    if args.keep_checkpoints is not None:
+        config = config.replace(keep_checkpoints=args.keep_checkpoints)
+    if args.io_retries is not None:
+        config = config.replace(io_retries=args.io_retries)
     overrides = {}
     for item in args.set:
         if "=" not in item:
@@ -228,6 +255,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     disarm()
 
     from . import runtime
+    from .resilience import CheckpointWriteError, SimulatedPreemption
+    from .resilience import retry as _retry
+
+    # process-wide IO-retry knobs for every phase (train re-applies them,
+    # but eval/test read shards and caption files through retry_io too)
+    _retry.configure(config.io_retries, config.io_retry_base_s)
 
     if config.phase == "train":
         state = runtime.setup_state(
@@ -237,7 +270,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             load_cnn=cli["load_cnn"],
             cnn_model_file=cli["cnn_model_file"],
         )
-        runtime.train(config, state=state)
+        try:
+            runtime.train(config, state=state)
+        except CheckpointWriteError as e:
+            # the run trained but a checkpoint it depends on did not land
+            # — warn + non-zero exit instead of a swallowed queue failure
+            # or a bare traceback (docs/RESILIENCE.md)
+            print(f"sat_tpu: WARNING: {e}", file=sys.stderr, flush=True)
+            return 1
+        except SimulatedPreemption as e:
+            # injected die-at-step-k: behave like the preempted process
+            # the injection simulates (non-zero exit; supervisor relaunches
+            # with --load)
+            print(f"sat_tpu: {e}", file=sys.stderr, flush=True)
+            return 1
+        # graceful SIGTERM/SIGINT: train() drained and returned normally —
+        # fall through to exit 0 so the supervisor relaunches into --load
     elif config.phase == "eval":
         if cli["sweep"]:
             sweep = runtime.evaluate_sweep(config)

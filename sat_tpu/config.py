@@ -76,6 +76,25 @@ class Config:
     # its loop for the whole save (base_model.py:61-62)
     async_checkpoint: bool = True
 
+    # ---- resilience (docs/RESILIENCE.md; no reference equivalent) ----
+    # Anomaly-sentinel policy at each log_every metrics fetch (the loop's
+    # one host sync — the sentinel adds no device syncs of its own):
+    # 'off' disarms; 'warn' reports and stops blessing LAST_GOOD while
+    # unhealthy; 'skip' additionally suppresses checkpoint writes while
+    # unhealthy; 'rollback' restores LAST_GOOD and fast-forwards the
+    # loader past the poison step (bounded, then degrades to warn).
+    anomaly_policy: str = "warn"
+    # loss > spike_factor × EMA(loss) counts as an anomaly (0 disables
+    # spike detection; NaN/Inf detection is always on when armed)
+    anomaly_spike_factor: float = 0.0
+    # checkpoint retention: keep the newest N plus the LAST_GOOD target
+    # (0 = keep everything, the reference's behavior)
+    keep_checkpoints: int = 0
+    # transient-IO retry budget + first-retry backoff for durable reads/
+    # writes (checkpoints, shard cache, manifests, caption files)
+    io_retries: int = 3
+    io_retry_base_s: float = 0.05
+
     # ---- dataset-size caps (reference config.py:60-63) ----
     max_train_ann_num: Optional[int] = 1000
     max_eval_ann_num: Optional[int] = 20
@@ -197,12 +216,19 @@ class Config:
             ("rng_impl", ("threefry2x32", "rbg", "unsafe_rbg")),
             ("ce_dtype", ("float32", "bfloat16")),
             ("shard_cache", ("auto", "on", "off")),
+            ("anomaly_policy", ("off", "warn", "skip", "rollback")),
         )
         for name, allowed in checks:
             if getattr(self, name) not in allowed:
                 raise ValueError(
                     f"Config.{name}={getattr(self, name)!r}: must be one of {allowed}"
                 )
+        if self.io_retries < 0:
+            raise ValueError(f"Config.io_retries={self.io_retries}: must be >= 0")
+        if self.keep_checkpoints < 0:
+            raise ValueError(
+                f"Config.keep_checkpoints={self.keep_checkpoints}: must be >= 0"
+            )
 
     def replace(self, **kw: Any) -> "Config":
         return dataclasses.replace(self, **kw)

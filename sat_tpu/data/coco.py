@@ -27,6 +27,7 @@ import json
 import os
 from typing import Dict, Iterable, List, Optional, Sequence, Set
 
+from ..utils.fileio import read_json
 from .tokenizer import tokenize
 
 
@@ -44,8 +45,12 @@ class CocoCaptions:
         self.max_ann_num = max_ann_num
 
         if annotation_file is not None:
-            with open(annotation_file) as f:
-                self.dataset = json.load(f)
+            # retrying read: caption JSONs usually live on the same shared
+            # filesystem as the shards, where transient EIO/ESTALE is a
+            # backoff, not a crash (resilience.retry)
+            self.dataset = read_json(
+                annotation_file, desc=f"read captions {annotation_file}"
+            )
             self._normalize_captions()
             self.create_index(max_ann_num)
 

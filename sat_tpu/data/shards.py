@@ -45,7 +45,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..utils.fileio import atomic_write
+from ..resilience.retry import retry_io
+from ..utils.fileio import atomic_write, read_text
 
 MANIFEST_NAME = "manifest.json"
 # Bump when the host preprocessing pipeline changes in any way that can
@@ -117,11 +118,13 @@ class ShardCache:
         missing/short.
         """
         path = os.path.join(cache_dir, MANIFEST_NAME)
-        with open(path) as f:  # FileNotFoundError -> "no cache here"
-            try:
-                manifest = json.load(f)
-            except json.JSONDecodeError as e:
-                raise ShardCacheMismatch(f"torn manifest {path}: {e}") from e
+        # retrying read: a flaky mount costs a backoff, not the cache
+        # (FileNotFoundError stays fatal-immediate -> "no cache here")
+        raw = read_text(path, desc=f"read shard manifest {path}")
+        try:
+            manifest = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ShardCacheMismatch(f"torn manifest {path}: {e}") from e
         if manifest.get("content_hash") != _manifest_hash(manifest):
             raise ShardCacheMismatch(
                 f"{path}: content hash mismatch (truncated or hand-edited)"
@@ -168,9 +171,10 @@ class ShardCache:
     def _shard(self, idx: int) -> np.memmap:
         mm = self._mmaps[idx]
         if mm is None:
-            mm = np.load(
-                os.path.join(self.cache_dir, self._shard_files[idx]),
-                mmap_mode="r",
+            path = os.path.join(self.cache_dir, self._shard_files[idx])
+            mm = retry_io(
+                lambda: np.load(path, mmap_mode="r"),
+                desc=f"mmap shard {path}",
             )
             self._mmaps[idx] = mm
         return mm

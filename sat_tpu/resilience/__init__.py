@@ -1,0 +1,65 @@
+"""Fault tolerance for preemptible-TPU training (docs/RESILIENCE.md).
+
+Four recovery paths, each provable under deterministic fault injection
+(``tests/test_resilience.py``):
+
+* **preemption** → periodic checkpoints + bitwise mid-epoch resume, with
+  graceful SIGTERM draining (:mod:`.preempt`);
+* **corrupt/torn checkpoints** → sha256 sidecars, post-write verify, the
+  ``LAST_GOOD`` pointer, restore walk-back (:mod:`.lineage`);
+* **NaN/diverging steps** → the log-boundary anomaly sentinel with
+  ``warn | skip | rollback`` policies (:mod:`.sentinel`);
+* **flaky storage** → classified, jittered-backoff IO retries
+  (:mod:`.retry`).
+
+Nothing here imports jax at module level; the injection harness
+(:mod:`.faultinject`) is inert unless ``SAT_FI_*`` env vars arm it.
+"""
+
+from .faultinject import (
+    FaultPlan,
+    InjectedIOError,
+    SimulatedPreemption,
+    corrupt_byte,
+    reset_io_faults,
+)
+from .lineage import (
+    CheckpointWriteError,
+    apply_retention,
+    checkpoint_steps,
+    file_sha256,
+    finalize_save,
+    last_good_checkpoint,
+    last_good_step,
+    mark_last_good,
+    sidecar_path,
+    verify_checkpoint,
+    write_sidecar,
+)
+from .preempt import GracefulShutdown
+from .retry import configure, is_retryable, retry_io
+from .sentinel import AnomalySentinel
+
+__all__ = [
+    "AnomalySentinel",
+    "CheckpointWriteError",
+    "FaultPlan",
+    "GracefulShutdown",
+    "InjectedIOError",
+    "SimulatedPreemption",
+    "apply_retention",
+    "checkpoint_steps",
+    "configure",
+    "corrupt_byte",
+    "file_sha256",
+    "finalize_save",
+    "is_retryable",
+    "last_good_checkpoint",
+    "last_good_step",
+    "mark_last_good",
+    "reset_io_faults",
+    "retry_io",
+    "sidecar_path",
+    "verify_checkpoint",
+    "write_sidecar",
+]

@@ -1,0 +1,189 @@
+"""Deterministic fault injection for the resilience test harness.
+
+Real TPU fleets fail in a handful of stereotyped ways — preemption mid
+step, a NaN gradient poisoning the state, a torn or bit-rotted checkpoint,
+a flaky network filesystem — and every one of this framework's recovery
+paths (docs/RESILIENCE.md) must be provable without waiting for the fleet
+to misbehave.  This module is the switchboard: each failure mode has ONE
+injection point, armed by an ``SAT_FI_*`` environment variable, firing
+deterministically at a configured step (or call count) and exactly once.
+
+All knobs are **inert by default**: with no ``SAT_FI_*`` variables set,
+every hook is a handful of host-side compares and the production hot loop
+is untouched (``tests/conftest.py`` asserts this).  No jax is imported at
+module level so the harness (and ``scripts/bench_ckpt.py``) stays usable
+on hosts with no accelerator backend at all.
+
+Knobs::
+
+    SAT_FI_DIE_AT_STEP=k       raise SimulatedPreemption before step k is
+                               dispatched (abrupt preemption; periodic
+                               checkpoints written so far are the only
+                               survivors)
+    SAT_FI_SIGTERM_AT_STEP=k   deliver a real SIGTERM to this process
+                               before step k (drives the *graceful*
+                               preemption path end-to-end)
+    SAT_FI_NAN_AT_STEP=k       poison the k-th completed step: params and
+                               metrics become NaN, as a diverged gradient
+                               would leave them
+    SAT_FI_CORRUPT_CKPT_STEP=k flip a byte in ``<k>.npz`` right after it
+                               is written (bit-rot between write and
+                               verify; the post-write verify must catch
+                               it and LAST_GOOD must not advance)
+    SAT_FI_IO_FAILURES=n[:sub] the first n ``retry_io`` attempts whose
+                               description contains ``sub`` (all, when no
+                               ``sub``) raise a retryable InjectedIOError
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import signal
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+ENV_PREFIX = "SAT_FI_"
+
+
+class SimulatedPreemption(RuntimeError):
+    """Injected die-at-step-k: the run is 'preempted' mid-loop.  Callers
+    treat it like the process vanishing — resume must come from the
+    checkpoints already on disk."""
+
+
+class InjectedIOError(OSError):
+    """Injected transient IO failure (classified retryable by
+    ``resilience.retry``: errno EIO)."""
+
+    def __init__(self, desc: str, remaining: int):
+        super().__init__(errno.EIO, f"injected transient IO error ({desc}; {remaining} more armed)")
+
+
+def _env_int(env: Dict[str, str], key: str) -> Optional[int]:
+    raw = env.get(ENV_PREFIX + key)
+    if raw is None or raw == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError as e:
+        raise ValueError(f"{ENV_PREFIX}{key}={raw!r}: expected an integer") from e
+
+
+@dataclass
+class FaultPlan:
+    """One training run's armed faults.  Step-keyed faults fire at most
+    once; a plan with nothing armed is ``inert`` and every hook is a
+    no-op compare."""
+
+    die_at_step: Optional[int] = None
+    sigterm_at_step: Optional[int] = None
+    nan_at_step: Optional[int] = None
+    corrupt_ckpt_step: Optional[int] = None
+    _fired: Dict[str, bool] = field(default_factory=dict)
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "FaultPlan":
+        env = os.environ if env is None else env
+        return cls(
+            die_at_step=_env_int(env, "DIE_AT_STEP"),
+            sigterm_at_step=_env_int(env, "SIGTERM_AT_STEP"),
+            nan_at_step=_env_int(env, "NAN_AT_STEP"),
+            corrupt_ckpt_step=_env_int(env, "CORRUPT_CKPT_STEP"),
+        )
+
+    @property
+    def inert(self) -> bool:
+        return (
+            self.die_at_step is None
+            and self.sigterm_at_step is None
+            and self.nan_at_step is None
+            and self.corrupt_ckpt_step is None
+        )
+
+    def _once(self, key: str) -> bool:
+        if self._fired.get(key):
+            return False
+        self._fired[key] = True
+        return True
+
+    # -- hooks consumed by runtime.train ----------------------------------
+
+    def maybe_kill(self, step: int) -> None:
+        """Before dispatching ``step``: simulated preemption (abrupt raise)
+        or a real self-SIGTERM (exercises the graceful-stop handler)."""
+        if self.die_at_step is not None and step >= self.die_at_step and self._once("die"):
+            raise SimulatedPreemption(f"injected preemption before step {step}")
+        if (
+            self.sigterm_at_step is not None
+            and step >= self.sigterm_at_step
+            and self._once("sigterm")
+        ):
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_poison(self, step_done: int, state: Any, metrics: Any) -> Tuple[Any, Any]:
+        """After the step that made the counter read ``step_done``: poison
+        params and metrics with NaN, as a diverged gradient update would.
+        Costs nothing unless armed AND firing (one tree_map on fire)."""
+        if self.nan_at_step is None or step_done != self.nan_at_step or not self._once("nan"):
+            return state, metrics
+        import jax  # deferred: inert plans must not need jax
+        import numpy as np
+
+        nan = float("nan")
+        poisoned_params = jax.tree_util.tree_map(lambda x: x * nan, state.params)
+        poisoned_metrics = {k: np.asarray(nan, np.float32) for k in metrics}
+        return state._replace(params=poisoned_params), poisoned_metrics
+
+    def maybe_corrupt_checkpoint(self, path: str, step: int) -> None:
+        """After ``<step>.npz`` landed: flip one byte mid-file (bit rot /
+        torn replication).  The post-write verify is expected to catch it."""
+        if (
+            self.corrupt_ckpt_step is None
+            or step != self.corrupt_ckpt_step
+            or not self._once("corrupt")
+        ):
+            return
+        corrupt_byte(path)
+
+
+def corrupt_byte(path: str, offset: Optional[int] = None) -> None:
+    """Flip one byte of ``path`` in place (test helper + injection body).
+    Defaults to the middle of the file — inside some array's compressed
+    payload, past the zip local headers."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    pos = size // 2 if offset is None else offset
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+# -- transient-IO injection (consumed by resilience.retry) -----------------
+
+# Keyed on the raw env value so re-arming with a new spec resets the
+# budget; cleared the moment the variable disappears.
+_io_state: Dict[str, Any] = {"spec": None, "remaining": 0, "match": ""}
+
+
+def consume_io_fault(desc: str) -> None:
+    """Called by ``retry_io`` before every attempt.  Inert (one dict get)
+    unless ``SAT_FI_IO_FAILURES`` is set."""
+    spec = os.environ.get(ENV_PREFIX + "IO_FAILURES")
+    if not spec:
+        _io_state["spec"] = None
+        return
+    if _io_state["spec"] != spec:
+        count, _, match = spec.partition(":")
+        _io_state.update(spec=spec, remaining=int(count), match=match)
+    if _io_state["remaining"] > 0 and _io_state["match"] in desc:
+        _io_state["remaining"] -= 1
+        raise InjectedIOError(desc, _io_state["remaining"])
+
+
+def reset_io_faults() -> None:
+    """Forget injection bookkeeping (test isolation)."""
+    _io_state.update(spec=None, remaining=0, match="")

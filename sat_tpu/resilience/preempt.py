@@ -1,0 +1,77 @@
+"""Graceful preemption: SIGTERM/SIGINT → stop at the next step boundary.
+
+TPU schedulers preempt with a SIGTERM and a grace window; dying mid-step
+wastes everything since the last periodic checkpoint.  ``GracefulShutdown``
+converts the first signal into a flag the train loop polls at each step
+boundary, so the loop can flush a final checkpoint through the async
+writer and return cleanly (exit 0 — the supervisor relaunches straight
+into the resume path).  A second signal restores the previous handler's
+behavior, so an operator's double Ctrl-C still kills a wedged run.
+
+Signal handlers can only be installed from the main thread; elsewhere
+(tests driving ``train()`` from a worker thread, notebook kernels) the
+context manager degrades to an inert flag — polling still works, nothing
+raises.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import Optional
+
+
+class GracefulShutdown:
+    """Context manager; ``stop_requested`` flips on the first SIGTERM or
+    SIGINT and the previous handlers come back on exit."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._previous = {}
+        self._installed = False
+        self.signal_name: Optional[str] = None
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop.is_set()
+
+    def _handler(self, signum, frame):
+        if self._stop.is_set():
+            # second signal: operator means it — fall through to the
+            # original disposition (usually KeyboardInterrupt / death)
+            previous = self._previous.get(signum)
+            if callable(previous):
+                previous(signum, frame)
+            elif previous == signal.SIG_DFL:
+                signal.signal(signum, signal.SIG_DFL)
+                signal.raise_signal(signum)
+            return
+        self._stop.set()
+        self.signal_name = signal.Signals(signum).name
+        print(
+            f"sat_tpu: caught {self.signal_name} — finishing the current "
+            "step, flushing a final checkpoint, then exiting cleanly "
+            "(signal again to force)",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    def __enter__(self) -> "GracefulShutdown":
+        if threading.current_thread() is threading.main_thread():
+            for sig in self.SIGNALS:
+                self._previous[sig] = signal.signal(sig, self._handler)
+            self._installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._installed:
+            for sig, previous in self._previous.items():
+                try:
+                    signal.signal(sig, previous)
+                except (ValueError, OSError):  # interpreter shutting down
+                    pass
+            self._installed = False
+        return None

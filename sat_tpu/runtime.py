@@ -19,6 +19,7 @@ Equivalent of the reference ``BaseModel.train/eval/test``
 from __future__ import annotations
 
 import os
+import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -38,6 +39,9 @@ from .train.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
+from .resilience import AnomalySentinel, FaultPlan, GracefulShutdown, lineage
+from .resilience import retry as _retry
+from .resilience.lineage import CheckpointWriteError
 from .train.step import TrainState, create_train_state, make_jit_train_step
 from .utils.fileio import atomic_write
 from .utils.progress import Progress, track
@@ -248,14 +252,19 @@ def train(
             # realign before the sharded placement: its cross-host
             # assert_equal opens a fresh communicator rendezvous
             sync_processes("sat_tpu:shard_state")
+            placement_config = config.replace(vocabulary_size=-1)
             state = shard_train_state(
-                state, config.replace(vocabulary_size=-1), mesh
+                state, placement_config, mesh
             )  # vocab rule disabled → fully replicated placement
             train_step = make_context_parallel_train_step(config, mesh)
         else:
             sync_processes("sat_tpu:shard_state")
+            placement_config = config
             state = shard_train_state(state, config, mesh)
             train_step = make_parallel_train_step(config, mesh)
+        # sentinel rollback restores host-side numpy leaves; mesh runs must
+        # re-place them with the same sharding rules as the initial state
+        reshard_state = lambda s: shard_train_state(s, placement_config, mesh)  # noqa: E731
         # feed keyed on the DATA-axis layout: processes along the model
         # axis (CP / cross-host TP) share a data row and feed identical
         # replicas of it (mesh_data_shard docstring)
@@ -268,6 +277,7 @@ def train(
     else:
         train_step = make_jit_train_step(config)
         place_batch = lambda b: b  # noqa: E731
+        reshard_state = lambda s: s  # noqa: E731 — jit re-places on dispatch
         # async device slot: batch k+1's host→HBM transfer is dispatched
         # while step k still runs, so the step never pays the copy
         wrap_feed = device_prefetch
@@ -284,14 +294,14 @@ def train(
     # with the device and defeating async dispatch + prefetch.  Sync once
     # here (resume-aware), then count locally; device_get only when logging.
     step = int(state.step)
-    # Mid-epoch resume: batch order is a pure function of (seed, epoch)
-    # (DataSet._set_epoch), so the cursor IS the global step — fast-forward
-    # to exactly where the checkpointed run stopped and the resumed run
-    # replays the identical batch + dropout-key sequence.
-    start_epoch, skip_batches = divmod(step, dataset.num_batches)
-    if start_epoch < config.num_epochs:
-        dataset.seek(start_epoch, skip_batches)
     stopped = False
+    # resilience wiring (docs/RESILIENCE.md): process-wide IO-retry knobs,
+    # the env-armed fault plan (inert in production — every hook is a
+    # host-side compare), the log-boundary anomaly sentinel, and graceful
+    # SIGTERM/SIGINT draining
+    _retry.configure(config.io_retries, config.io_retry_base_s)
+    plan = FaultPlan.from_env()
+    sentinel = AnomalySentinel(config.anomaly_policy, config.anomaly_spike_factor)
     # async checkpointing: the step loop pays only the device→host
     # snapshot; serialization + disk write overlap the following steps
     # (AsyncCheckpointWriter docstring; sync fallback multi-host/off)
@@ -303,12 +313,13 @@ def train(
     ckpt_save = async_writer.save if async_writer else save_checkpoint
     import contextlib
 
+    final_path: Optional[str] = None
     # the ExitStack drains the async writer LAST (after SummaryWriter
     # closes), on success and on exception alike — queued checkpoint
     # writes survive an interrupt and worker failures surface
     with contextlib.ExitStack() as _stack, SummaryWriter(
         config.summary_dir
-    ) as writer:
+    ) as writer, GracefulShutdown() as shutdown:
         if async_writer:
             _stack.callback(async_writer.close)
         # resume-aware trace window (>= start, once); the ExitStack exit
@@ -321,53 +332,161 @@ def train(
             # apart (sync_processes docstring; imported with the mesh
             # machinery above under this same condition)
             sync_processes("sat_tpu:first_step")
-        for epoch in range(start_epoch, config.num_epochs):
-            # per-batch visibility, tqdm-style (reference base_model.py:49-50);
-            # metric-free so the async dispatch chain never syncs for it
-            bar = Progress(
-                dataset.num_batches,
-                desc=f"epoch {epoch + 1}/{config.num_epochs}",
-                initial=skip_batches if epoch == start_epoch else 0,
-            )
-            for batch in wrap_feed(loader):
-                if config.max_steps and step >= config.max_steps:
-                    stopped = True
-                    break
-                prof.before_step(step)
-                state, metrics = train_step(
-                    state,
-                    place_batch(
-                        {
-                            "images": batch["images"],
-                            "word_idxs": batch["word_idxs"],
-                            "masks": batch["masks"],
-                        }
-                    ),
-                    jax.random.fold_in(root_rng, step),
+        while True:  # re-entered only by a sentinel rollback
+            rollback = False
+            # Mid-epoch resume: batch order is a pure function of (seed,
+            # epoch) (DataSet._set_epoch), so the cursor IS the global step
+            # — fast-forward to exactly where the checkpointed run stopped
+            # and the resumed run replays the identical batch + dropout-key
+            # sequence.  A rollback re-enters here with restored weights
+            # and the cursor already PAST the poison step.
+            start_epoch, skip_batches = divmod(step, dataset.num_batches)
+            if start_epoch < config.num_epochs:
+                dataset.seek(start_epoch, skip_batches)
+            for epoch in range(start_epoch, config.num_epochs):
+                # per-batch visibility, tqdm-style (reference
+                # base_model.py:49-50); metric-free so the async dispatch
+                # chain never syncs for it
+                bar = Progress(
+                    dataset.num_batches,
+                    desc=f"epoch {epoch + 1}/{config.num_epochs}",
+                    initial=skip_batches if epoch == start_epoch else 0,
                 )
-                prof.after_step(step, state)
-                step += 1  # == int(state.step), without a device sync
-                if step % config.log_every == 0:
-                    host = {k: float(v) for k, v in jax.device_get(metrics).items()}
-                    writer.scalars(step, host)
-                if (
-                    config.var_summary_period
-                    and step % config.var_summary_period == 0
-                ):
-                    writer.variable_stats(step, state.params)
-                if config.save_period and step % config.save_period == 0:
-                    ckpt_save(state, config)
-                bar.update()
-            bar.close()
-            if stopped:
-                break
-            print(f"epoch {epoch + 1}/{config.num_epochs} done (step {int(state.step)})")
+                for batch in wrap_feed(loader):
+                    if config.max_steps and step >= config.max_steps:
+                        stopped = True
+                        break
+                    plan.maybe_kill(step)  # injected preemption (inert unarmed)
+                    if shutdown.stop_requested:
+                        # stop at the step boundary: the final save below
+                        # flushes through the writer and train() returns
+                        # cleanly so the CLI can exit 0 for the supervisor
+                        stopped = True
+                        break
+                    prof.before_step(step)
+                    state, metrics = train_step(
+                        state,
+                        place_batch(
+                            {
+                                "images": batch["images"],
+                                "word_idxs": batch["word_idxs"],
+                                "masks": batch["masks"],
+                            }
+                        ),
+                        jax.random.fold_in(root_rng, step),
+                    )
+                    prof.after_step(step, state)
+                    step += 1  # == int(state.step), without a device sync
+                    # injected NaN gradient (inert unarmed): poisons params
+                    # and metrics exactly as a diverged update would
+                    state, metrics = plan.maybe_poison(step, state, metrics)
+                    if step % config.log_every == 0:
+                        # the loop's ONE host sync — the sentinel reads
+                        # these already-fetched floats, adding no syncs
+                        host = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                        writer.scalars(step, host)
+                        if sentinel.check(step, host) == "rollback":
+                            rollback = True
+                            break
+                    if (
+                        config.var_summary_period
+                        and step % config.var_summary_period == 0
+                    ):
+                        writer.variable_stats(step, state.params)
+                    if (
+                        config.save_period
+                        and step % config.save_period == 0
+                        and not sentinel.suppress_save
+                    ):
+                        ckpt_save(state, config, healthy=sentinel.healthy)
+                    bar.update()
+                bar.close()
+                if stopped or rollback:
+                    break
+                print(f"epoch {epoch + 1}/{config.num_epochs} done (step {int(state.step)})")
+            if rollback:
+                if async_writer:
+                    # the save that blessed LAST_GOOD may still be queued;
+                    # the pointer is only readable once it drains
+                    async_writer.flush()
+                restored = _restore_last_good(state, config, step)
+                if restored is None:
+                    # nothing verifiable to roll back to — degrade to warn
+                    # and keep training rather than dying here
+                    sentinel.policy = "warn"
+                else:
+                    state = reshard_state(restored)
+                    sentinel.note_rolled_back()
+                continue
+            break
         # the final save rides the same queue: submission order guarantees
         # it lands AFTER any still-draining periodic write (config.json
         # must end at the final step), and the ExitStack close joins the
         # worker before train() returns
-        ckpt_save(state, config)
+        if sentinel.suppress_save:
+            print(
+                "sat_tpu: final checkpoint suppressed — metrics were "
+                f"anomalous under anomaly_policy=skip ({sentinel.last_reason})",
+                file=sys.stderr,
+                flush=True,
+            )
+        else:
+            final_path = ckpt_save(state, config, healthy=sentinel.healthy)
+        if shutdown.stop_requested:
+            print(
+                f"sat_tpu: stopped on {shutdown.signal_name} at step {step}; "
+                "final checkpoint flushed — relaunch with --load to resume",
+                file=sys.stderr,
+                flush=True,
+            )
+    # the writer is drained here; the final save must actually be on disk
+    # and restorable before train() reports success (a lost final
+    # checkpoint silently discards the training tail)
+    if final_path is not None and jax.process_index() == 0:
+        ok, reason = lineage.verify_checkpoint(final_path)
+        if not ok:
+            raise CheckpointWriteError(
+                f"final checkpoint {final_path} did not land: {reason}"
+            )
     return state
+
+
+def _restore_last_good(
+    state: TrainState, config: Config, step: int
+) -> Optional[TrainState]:
+    """Sentinel-rollback restore: load the newest verifiable ``LAST_GOOD``
+    checkpoint into the (poisoned) state skeleton, keeping the HOST step
+    counter — the loader then fast-forwards PAST the poison step instead
+    of replaying it (with deterministic dropout keys a replay would just
+    reproduce the same divergence).  Returns None when nothing verifiable
+    exists (caller degrades to warn)."""
+    path = lineage.last_good_checkpoint(config.save_dir)
+    if path is None:
+        print(
+            "sat_tpu: rollback requested but save_dir holds no verifiable "
+            f"LAST_GOOD checkpoint ({config.save_dir})",
+            file=sys.stderr,
+            flush=True,
+        )
+        return None
+    restored, count = restore_checkpoint(state, model_file=path)
+    if count == 0:
+        print(
+            f"sat_tpu: rollback restore from {path} loaded 0 tensors",
+            file=sys.stderr,
+            flush=True,
+        )
+        return None
+    print(
+        f"sat_tpu: rolled back to {path} "
+        f"(step {int(np.asarray(restored.step))}); resuming forward at "
+        f"step {step}, skipping the poison window",
+        file=sys.stderr,
+        flush=True,
+    )
+    # device-owned copy, not a numpy scalar: the step leaf is donated into
+    # train_step along with the rest of the state (see _assign_leaves)
+    return restored._replace(step=jax.numpy.array(np.asarray(step, np.int32)))
 
 
 # ---------------------------------------------------------------------------
@@ -848,13 +967,9 @@ def evaluate_sweep(config: Config) -> Dict[int, Dict[str, float]]:
     paid ONCE across the sweep — the eval split is prepared a single time
     and every checkpoint restores into one initialized state skeleton, so
     sweep cost is O(prep) + N×O(restore + decode)."""
-    import re
-
-    steps = sorted(
-        int(m.group(1))
-        for fn in os.listdir(config.save_dir)
-        if (m := re.fullmatch(r"(\d+)\.npz", fn))
-    )
+    # the lineage scan skips temp/partial/zero-byte files, so an in-flight
+    # or torn write never enters the sweep
+    steps = lineage.checkpoint_steps(config.save_dir)
     prepared = prepare_eval_data(config)
     skeleton = create_train_state(jax.random.PRNGKey(config.seed), config)
     sweep: Dict[int, Dict[str, float]] = {}

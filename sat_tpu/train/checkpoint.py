@@ -28,13 +28,17 @@ never leaves a torn file — the failure-recovery story the reference lacks.
 from __future__ import annotations
 
 import os
-import re
+import sys
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
+from ..resilience import faultinject, lineage
+from ..resilience.lineage import CheckpointWriteError
+from ..resilience.retry import retry_io
 from ..utils.dist import gather_tree_replicated
 from ..utils.fileio import atomic_write
 
@@ -82,7 +86,16 @@ def _assign_leaves(tree: Any, prefix: str, data: Dict[str, np.ndarray]):
         if name in data:
             value = np.asarray(data[name])
             if hasattr(leaf, "shape") and tuple(value.shape) == tuple(leaf.shape):
-                new_leaves.append(value.astype(leaf.dtype))
+                # jnp.array, not the raw numpy value: the CPU backend turns
+                # an aligned numpy argument into a ZERO-COPY device buffer
+                # that borrows the host memory, and train_step's
+                # donate_argnums then lets XLA free/reuse a buffer it never
+                # owned — a use-after-free that shows up as heap pointers in
+                # restored Adam slots on resume (timing-dependent; the
+                # persistent compile cache makes it reproducible).  An
+                # explicit device copy gives every restored leaf an
+                # XLA-owned buffer, same as fresh-init jit outputs.
+                new_leaves.append(jnp.array(value.astype(leaf.dtype)))
                 count += 1
                 continue
         new_leaves.append(leaf)
@@ -102,8 +115,19 @@ def state_to_flat(state: Any) -> Dict[str, np.ndarray]:
     flat.update(flatten_with_names(state.opt_state, "optimizer/"))
     flat["global_step"] = np.asarray(state.step)
     flat = gather_tree_replicated(flat)
-    # one batched D2H transfer for the whole dict, not one per leaf
-    return {k: np.asarray(v) for k, v in jax.device_get(flat).items()}
+    # One batched D2H transfer for the whole dict, not one per leaf.  The
+    # snapshot must OWN its bytes: on the CPU backend device_get returns
+    # zero-copy views of the live device buffers, and those buffers are
+    # donated into the next dispatched step (train/step.py donate_argnums)
+    # — an async writer serializing a view after donation would persist
+    # whatever XLA wrote over it (observed as denormal garbage in Adam mu
+    # slots of resumed runs).  OWNDATA is False exactly for such views, so
+    # TPU-path arrays (device_get already copied) aren't copied twice.
+    host = jax.device_get(flat)
+    return {
+        k: v if isinstance(v, np.ndarray) and v.flags["OWNDATA"] else np.array(v)
+        for k, v in host.items()
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -155,13 +179,18 @@ class AsyncCheckpointWriter:
         self._thread.start()
 
     def _run(self) -> None:
+        import threading
+
         while True:
             item = self._q.get()
             if item is None:
                 return
-            flat, path, config, save_dir = item
+            if isinstance(item, threading.Event):  # flush() barrier
+                item.set()
+                continue
+            flat, path, config, save_dir, healthy = item
             try:
-                _write_flat(flat, path, config, save_dir)
+                _write_flat(flat, path, config, save_dir, healthy=healthy)
             except BaseException as e:  # surfaced on next save/close
                 with self._error_lock:
                     if self._error is None:  # keep the FIRST failure (root cause)
@@ -175,18 +204,39 @@ class AsyncCheckpointWriter:
         with self._error_lock:
             e = self._error
         if e is not None:
-            raise RuntimeError("async checkpoint write failed") from e
+            # CheckpointWriteError subclasses RuntimeError, so callers
+            # matching the long-standing message keep working while the
+            # CLI can map the typed failure to a non-zero exit
+            raise CheckpointWriteError("async checkpoint write failed") from e
 
-    def save(self, state: Any, config: Config, save_dir: Optional[str] = None) -> str:
+    def save(
+        self,
+        state: Any,
+        config: Config,
+        save_dir: Optional[str] = None,
+        healthy: bool = True,
+    ) -> str:
         self._check()
         if jax.process_count() > 1:
-            return save_checkpoint(state, config, save_dir)
+            return save_checkpoint(state, config, save_dir, healthy=healthy)
         save_dir = save_dir or config.save_dir
         flat = state_to_flat(state)  # the synchronous part
         step = int(flat["global_step"])
         path = os.path.join(save_dir, f"{step}.npz")
-        self._q.put((flat, path, config, save_dir))
+        self._q.put((flat, path, config, save_dir, healthy))
         return path
+
+    def flush(self) -> None:
+        """Block until every save queued so far is on disk (with its
+        lineage tail applied), then surface any worker failure.  The
+        rollback path needs this: LAST_GOOD is only readable after the
+        write that blesses it has drained."""
+        import threading
+
+        barrier = threading.Event()
+        self._q.put(barrier)
+        barrier.wait()
+        self._check()
 
     def close(self) -> None:
         """Drain pending writes; re-raise the first worker failure."""
@@ -202,23 +252,55 @@ class AsyncCheckpointWriter:
 
 
 def _write_flat(
-    flat: Dict[str, np.ndarray], path: str, config: Config, save_dir: str
+    flat: Dict[str, np.ndarray],
+    path: str,
+    config: Config,
+    save_dir: str,
+    healthy: bool = True,
 ) -> None:
     """The disk half of a checkpoint save (shared by the sync and async
-    paths): atomic npz + config.json sidecar."""
+    paths): atomic npz + config.json sidecar, then the lineage tail —
+    sha256 sidecar, post-write verify, LAST_GOOD advance (only when the
+    verify passed AND the run was ``healthy`` at its last metrics check),
+    and keep-N retention (docs/RESILIENCE.md)."""
     step = int(flat["global_step"])
     # write through the file object: np.savez(path) appends '.npz' itself
-    atomic_write(path, "wb", lambda f: np.savez(f, **flat))
-    config.replace(global_step=step).save(os.path.join(save_dir, "config.json"))
+    retry_io(
+        lambda: atomic_write(path, "wb", lambda f: np.savez(f, **flat)),
+        desc=f"write checkpoint {path}",
+    )
+    # hash NOW, while the file is still exactly what we serialized: a
+    # sidecar computed later would faithfully fingerprint whatever rot
+    # happened in between and the verify would bless corrupt bytes
+    lineage.write_sidecar(path)
+    retry_io(
+        lambda: config.replace(global_step=step).save(
+            os.path.join(save_dir, "config.json")
+        ),
+        desc=f"write checkpoint config {save_dir}",
+    )
+    # injection point: bit-rot between the rename and the verify — the
+    # post-write verify below must catch it and refuse to bless the file
+    faultinject.FaultPlan.from_env().maybe_corrupt_checkpoint(path, step)
+    lineage.finalize_save(
+        save_dir, path, step, healthy=healthy, keep=config.keep_checkpoints
+    )
 
 
-def save_checkpoint(state: Any, config: Config, save_dir: Optional[str] = None) -> str:
+def save_checkpoint(
+    state: Any,
+    config: Config,
+    save_dir: Optional[str] = None,
+    healthy: bool = True,
+) -> str:
     """Write ``<global_step>.npz`` + ``config.json`` under save_dir.
 
     Mirrors the reference's save (base_model.py:242-255): everything —
     params, BN stats, optimizer slots, global step — in one flat archive,
     with the config (embedding global_step) alongside for
-    resume-from-latest.  Atomic via tmp+rename.
+    resume-from-latest.  Atomic via tmp+rename; ``healthy=False`` (the
+    anomaly sentinel saw non-finite metrics) still writes the file but
+    withholds the ``LAST_GOOD`` blessing.
     """
     save_dir = save_dir or config.save_dir
     flat = state_to_flat(state)
@@ -227,7 +309,7 @@ def save_checkpoint(state: Any, config: Config, save_dir: Optional[str] = None) 
     if jax.process_index() == 0:
         # process 0 writes; other hosts only participated in the gather
         # (the reference's chief-writes checkpointing, main_distributed.py:64)
-        _write_flat(flat, path, config, save_dir)
+        _write_flat(flat, path, config, save_dir, healthy=healthy)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -237,32 +319,39 @@ def save_checkpoint(state: Any, config: Config, save_dir: Optional[str] = None) 
 
 def latest_checkpoint(save_dir: str) -> Optional[str]:
     """Resolve the newest checkpoint like the reference's config.pickle
-    lookup (base_model.py:262-269), falling back to a directory scan."""
-    steps = []
+    lookup (base_model.py:262-269), falling back to a directory scan.
+
+    The scan (``resilience.lineage.checkpoint_steps``) accepts only real,
+    non-empty ``<step>.npz`` regular files — in-flight atomic-write temps,
+    sidecars, ``slim.npz`` exports, zero-byte husks from a full disk, and
+    lookalike directories are never mis-parsed into a candidate."""
+    steps = set(lineage.checkpoint_steps(save_dir))
     cfg_path = os.path.join(save_dir, "config.json")
+    # The config.json pointer can name a step the scan rejected (e.g. its
+    # npz truncated to zero bytes) — intersect, don't trust.
     if os.path.exists(cfg_path):
         try:
-            steps.append(int(Config.load(cfg_path).global_step))
+            pointed = int(Config.load(cfg_path).global_step)
         except (ValueError, KeyError, TypeError):
             pass  # torn config.json → rely on the directory scan
-    # Always scan too: a preemption between the npz rename and the
-    # config.json update would otherwise leave a stale pointer shadowing
-    # the newest fully-written checkpoint.
-    if os.path.isdir(save_dir):
-        for fn in os.listdir(save_dir):
-            m = re.fullmatch(r"(\d+)\.npz", fn)
-            if m:
-                steps.append(int(m.group(1)))
-    for step in sorted(set(steps), reverse=True):
-        path = os.path.join(save_dir, f"{step}.npz")
-        if os.path.exists(path):
-            return path
+        else:
+            path = os.path.join(save_dir, f"{pointed}.npz")
+            try:
+                if os.path.isfile(path) and os.path.getsize(path) > 0:
+                    steps.add(pointed)
+            except OSError:
+                pass
+    if steps:
+        return os.path.join(save_dir, f"{max(steps)}.npz")
     return None
 
 
 def load_flat(path: str) -> Dict[str, np.ndarray]:
-    with np.load(path, allow_pickle=False) as z:
-        return {k: z[k] for k in z.files}
+    def _read() -> Dict[str, np.ndarray]:
+        with np.load(path, allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+
+    return retry_io(_read, desc=f"read checkpoint {path}")
 
 
 def restore_checkpoint(
@@ -275,18 +364,50 @@ def restore_checkpoint(
     shape-mismatched entries are skipped (partial restore), so trimmed
     inference checkpoints load cleanly into a full train state.
     Returns (new_state, tensors_loaded).
+
+    In ``save_dir`` mode a torn / corrupt / unreadable newest checkpoint
+    is not fatal: each candidate is integrity-checked
+    (``resilience.lineage.verify_checkpoint`` — sha256 sidecar when
+    present, zip CRC otherwise) and the restore walks back to the newest
+    checkpoint that verifies AND loads.  An explicit ``model_file`` is
+    the operator saying "this file" — it is loaded as-is and failures
+    propagate.
     """
-    path = model_file or (latest_checkpoint(save_dir) if save_dir else None)
-    if path is None:
-        raise FileNotFoundError(f"no checkpoint found (save_dir={save_dir!r})")
-    flat = load_flat(path)
+    if model_file:
+        flat = load_flat(model_file)
+    else:
+        if not save_dir:
+            raise FileNotFoundError(f"no checkpoint found (save_dir={save_dir!r})")
+        flat = None
+        rejected = []
+        for step in sorted(lineage.checkpoint_steps(save_dir), reverse=True):
+            path = os.path.join(save_dir, f"{step}.npz")
+            ok, reason = lineage.verify_checkpoint(path)
+            if ok:
+                try:
+                    flat = load_flat(path)
+                    break
+                except (OSError, ValueError) as e:  # verified yet unloadable
+                    reason = f"load failed: {e}"
+            rejected.append(f"{os.path.basename(path)} ({reason})")
+            print(
+                f"sat_tpu: checkpoint {path} rejected ({reason}); "
+                "walking back to an older checkpoint",
+                file=sys.stderr,
+                flush=True,
+            )
+        if flat is None:
+            detail = f"; rejected: {', '.join(rejected)}" if rejected else ""
+            raise FileNotFoundError(
+                f"no verifiable checkpoint found (save_dir={save_dir!r}{detail})"
+            )
 
     params, n_p = _assign_leaves(state.params, "params/", flat)
     batch_stats, n_b = _assign_leaves(state.batch_stats, "batch_stats/", flat)
     opt_state, n_o = _assign_leaves(state.opt_state, "optimizer/", flat)
     step = state.step
     if "global_step" in flat:
-        step = np.asarray(flat["global_step"], dtype=np.int32)
+        step = jnp.array(np.asarray(flat["global_step"], dtype=np.int32))
     new_state = state._replace(
         params=params, batch_stats=batch_stats, opt_state=opt_state, step=step
     )

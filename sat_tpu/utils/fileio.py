@@ -6,13 +6,19 @@ process can leave torn files.  Every durable artifact in this framework
 goes through ``atomic_write`` instead: tmp file + rename, with the final
 mode honoring the process umask (mkstemp alone would leave 0600 files
 other readers of a shared filesystem can't open).
+
+``read_text``/``read_json`` are the retrying read-side twins: small
+durable inputs (manifests, caption JSONs, config sidecars) read through
+``resilience.retry.retry_io`` so a flaky network mount costs a backoff,
+not the run.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import tempfile
-from typing import Callable, IO
+from typing import Any, Callable, IO
 
 
 def atomic_write(path: str, mode: str, writer: Callable[[IO], None]) -> None:
@@ -35,3 +41,24 @@ def atomic_write(path: str, mode: str, writer: Callable[[IO], None]) -> None:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+
+
+def read_text(path: str, desc: str = "") -> str:
+    """Read a small text file with transient-IO retries (fatal errors —
+    missing file, permissions — raise immediately; see resilience.retry)."""
+    # lazy import: fileio is a leaf utility and resilience.lineage imports
+    # it back for sidecar writes
+    from ..resilience.retry import retry_io
+
+    def _read() -> str:
+        with open(path) as f:
+            return f.read()
+
+    return retry_io(_read, desc=desc or f"read {path}")
+
+
+def read_json(path: str, desc: str = "") -> Any:
+    """``read_text`` + ``json.loads`` — the whole read retries as a unit,
+    so a torn page mid-parse re-reads the file rather than failing on a
+    half-delivered buffer."""
+    return json.loads(read_text(path, desc=desc or f"read json {path}"))

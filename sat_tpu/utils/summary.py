@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+import sys
 import time
 from typing import Any, Dict, Mapping, Optional
 
@@ -261,10 +262,43 @@ class SummaryWriter:
         self._jsonl_path = os.path.join(log_dir, "metrics.jsonl")
         self._events = open(self._event_path, "ab")
         self._jsonl = open(self._jsonl_path, "a")
-        self._events.write(
+        self._closed = False
+        self._degraded = False
+        self._write_events(
             _frame_record(
                 _encode_event(time.time(), 0, file_version="brain.Event:2")
             )
+        )
+
+    # Observability must degrade, never kill training: once the loop is
+    # unwinding (exception, SIGTERM teardown, disk full) a failing event
+    # write would mask the real outcome with a logging traceback.  The
+    # first failure warns and the writer goes quiet; close() is idempotent
+    # because both the with-block AND an outer ExitStack may reach it.
+    def _write_events(self, data: bytes) -> None:
+        if self._closed or self._degraded:
+            return
+        try:
+            self._events.write(data)
+        except (OSError, ValueError) as e:
+            self._degrade("event file", e)
+
+    def _write_jsonl(self, line: str) -> None:
+        if self._closed or self._degraded:
+            return
+        try:
+            self._jsonl.write(line)
+        except (OSError, ValueError) as e:
+            self._degrade("metrics.jsonl", e)
+
+    def _degrade(self, what: str, exc: BaseException) -> None:
+        if self._degraded:  # warn once; later failures are the same story
+            return
+        self._degraded = True
+        print(
+            f"sat_tpu: summary writer disabled — {what} write failed: {exc}",
+            file=sys.stderr,
+            flush=True,
         )
 
     def scalars(self, step: int, values: Mapping[str, float]) -> None:
@@ -283,10 +317,10 @@ class SummaryWriter:
         if not record:
             return
         if clean:
-            self._events.write(
+            self._write_events(
                 _frame_record(_encode_event(time.time(), step, clean))
             )
-        self._jsonl.write(json.dumps({"step": int(step), **record}) + "\n")
+        self._write_jsonl(json.dumps({"step": int(step), **record}) + "\n")
 
     def histograms(self, step: int, values: Mapping[str, Any]) -> None:
         """True HistogramProto summaries (reference model.py:527) for
@@ -295,7 +329,7 @@ class SummaryWriter:
             _field_len(1, _encode_histo_value(tag, _histo_from_array(v)))
             for tag, v in values.items()
         )
-        self._events.write(
+        self._write_events(
             _frame_record(
                 _encode_event(time.time(), step, summary_bytes=summary)
             )
@@ -329,20 +363,31 @@ class SummaryWriter:
             histo = _encode_histo(hlo, hhi, num, total, sumsq, counts)
             histo_summary += _field_len(1, _encode_histo_value(name, histo))
         self.scalars(step, stats)
-        self._events.write(
+        self._write_events(
             _frame_record(
                 _encode_event(time.time(), step, summary_bytes=histo_summary)
             )
         )
 
     def flush(self) -> None:
-        self._events.flush()
-        self._jsonl.flush()
+        if self._closed:
+            return
+        try:
+            self._events.flush()
+            self._jsonl.flush()
+        except (OSError, ValueError) as e:
+            self._degrade("flush", e)
 
     def close(self) -> None:
+        if self._closed:
+            return
         self.flush()
-        self._events.close()
-        self._jsonl.close()
+        self._closed = True
+        try:
+            self._events.close()
+            self._jsonl.close()
+        except (OSError, ValueError) as e:
+            self._degrade("close", e)
 
     def __enter__(self) -> "SummaryWriter":
         return self

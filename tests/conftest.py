@@ -32,6 +32,23 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _fault_injection_inert():
+    """Fault injection must be opt-in per test: no SAT_FI_* variable may
+    leak in from the environment or out of a test, and the armed/consumed
+    bookkeeping resets so injection counts never bleed between tests."""
+    from sat_tpu.resilience import faultinject
+
+    stray = [k for k in os.environ if k.startswith(faultinject.ENV_PREFIX)]
+    assert not stray, f"fault-injection env leaked into the test run: {stray}"
+    assert faultinject.FaultPlan.from_env().inert
+    faultinject.reset_io_faults()
+    yield
+    for k in [k for k in os.environ if k.startswith(faultinject.ENV_PREFIX)]:
+        del os.environ[k]
+    faultinject.reset_io_faults()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -1,0 +1,583 @@
+"""Resilience subsystem: fault injection, checkpoint lineage, anomaly
+sentinel, graceful preemption, retrying host IO (docs/RESILIENCE.md).
+
+The integration tests drive every recovery path end-to-end through
+``runtime.train`` with the ``SAT_FI_*`` injection knobs, on the same tiny
+model the runtime tests use; the unit tests pin the layer contracts
+(retry classification + backoff, lineage verify/walk-back/retention,
+sentinel policies) without touching a training loop.
+"""
+
+import errno
+import os
+import signal
+import time
+from typing import Dict, NamedTuple
+
+import numpy as np
+import pytest
+
+from sat_tpu import runtime
+from sat_tpu.config import Config
+from sat_tpu.resilience import lineage
+from sat_tpu.resilience.faultinject import (
+    FaultPlan,
+    InjectedIOError,
+    SimulatedPreemption,
+    corrupt_byte,
+)
+from sat_tpu.resilience.preempt import GracefulShutdown
+from sat_tpu.resilience.retry import is_retryable, retry_io
+from sat_tpu.resilience.sentinel import MAX_ROLLBACKS, AnomalySentinel
+from sat_tpu.train.checkpoint import (
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+    state_to_flat,
+)
+
+SMALL_MODEL = dict(
+    image_size=32,
+    dim_embedding=16,
+    num_lstm_units=16,
+    dim_initialize_layer=16,
+    dim_attend_layer=16,
+    dim_decode_layer=32,
+    compute_dtype="float32",
+    save_period=3,
+    log_every=1,
+    num_epochs=1,
+    num_data_workers=2,
+)
+
+
+# ---------------------------------------------------------------------------
+# retry_io: backoff + classification
+# ---------------------------------------------------------------------------
+
+
+def _flaky(failures, exc_factory):
+    """A zero-arg fn failing ``failures`` times before returning 'done'."""
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] <= failures:
+            raise exc_factory()
+        return "done"
+
+    return fn, calls
+
+
+def test_retry_backoff_sequence_and_success():
+    fn, calls = _flaky(3, lambda: OSError(errno.EIO, "mount hiccup"))
+    sleeps = []
+    out = retry_io(
+        fn,
+        desc="unit",
+        retries=3,
+        base_delay_s=0.1,
+        jitter=(1.0, 1.0),  # disable jitter: the sequence is exact
+        sleep=sleeps.append,
+    )
+    assert out == "done"
+    assert calls["n"] == 4
+    np.testing.assert_allclose(sleeps, [0.1, 0.2, 0.4])
+
+
+def test_retry_delay_capped():
+    fn, _ = _flaky(3, lambda: OSError(errno.ESTALE, "stale handle"))
+    sleeps = []
+    retry_io(
+        fn,
+        desc="unit",
+        retries=3,
+        base_delay_s=1.0,
+        max_delay_s=1.5,
+        jitter=(1.0, 1.0),
+        sleep=sleeps.append,
+    )
+    np.testing.assert_allclose(sleeps, [1.0, 1.5, 1.5])
+
+
+def test_retry_fatal_raises_immediately():
+    fn, calls = _flaky(99, FileNotFoundError)
+    sleeps = []
+    with pytest.raises(FileNotFoundError):
+        retry_io(fn, desc="unit", retries=3, sleep=sleeps.append)
+    assert calls["n"] == 1  # wrong-environment errors never retry
+    assert sleeps == []
+
+
+def test_retry_exhausted_raises_last_error():
+    fn, calls = _flaky(99, lambda: OSError(errno.EIO, "still down"))
+    sleeps = []
+    with pytest.raises(OSError, match="still down"):
+        retry_io(fn, desc="unit", retries=2, base_delay_s=0.0, sleep=sleeps.append)
+    assert calls["n"] == 3  # 1 try + 2 retries
+    assert len(sleeps) == 2
+
+
+def test_retry_non_oserror_propagates_untouched():
+    fn, calls = _flaky(99, lambda: ValueError("bad payload"))
+    with pytest.raises(ValueError):
+        retry_io(fn, desc="unit", retries=3, sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_is_retryable_classification():
+    assert is_retryable(OSError(errno.EIO, "x"))
+    assert is_retryable(OSError(errno.ESTALE, "x"))
+    assert is_retryable(TimeoutError())
+    assert is_retryable(ConnectionResetError())
+    assert is_retryable(InjectedIOError("x", 0))
+    assert not is_retryable(FileNotFoundError())
+    assert not is_retryable(PermissionError())
+    assert not is_retryable(IsADirectoryError())
+    assert not is_retryable(ValueError())
+
+
+def test_injected_io_failures_env(monkeypatch):
+    monkeypatch.setenv("SAT_FI_IO_FAILURES", "2")
+    fn, calls = _flaky(0, RuntimeError)  # fn itself never fails
+    sleeps = []
+    out = retry_io(fn, desc="anything", retries=3, base_delay_s=0.0, sleep=sleeps.append)
+    assert out == "done"
+    assert calls["n"] == 1  # injection fires BEFORE fn; fn ran once
+    assert len(sleeps) == 2  # two injected attempts were retried
+
+
+def test_injected_io_failures_substring_filter(monkeypatch):
+    monkeypatch.setenv("SAT_FI_IO_FAILURES", "5:manifest")
+    ok, _ = _flaky(0, RuntimeError)
+    # non-matching description: untouched, no retries
+    sleeps = []
+    assert retry_io(ok, desc="read checkpoint", retries=0, sleep=sleeps.append) == "done"
+    assert sleeps == []
+    # matching description with no retry budget: the injection surfaces
+    fn2, _ = _flaky(0, RuntimeError)
+    with pytest.raises(InjectedIOError):
+        retry_io(fn2, desc="read shard manifest", retries=0, sleep=sleeps.append)
+
+
+# ---------------------------------------------------------------------------
+# lineage: sidecars, verification, LAST_GOOD, retention
+# ---------------------------------------------------------------------------
+
+
+def _write_npz(path, **arrays):
+    if not arrays:
+        arrays = {"w": np.arange(8, dtype=np.float32)}
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+    return path
+
+
+def test_sidecar_catches_bit_rot(tmp_path):
+    path = _write_npz(str(tmp_path / "3.npz"))
+    lineage.write_sidecar(path)
+    assert lineage.verify_checkpoint(path) == (True, "sha256 ok")
+    corrupt_byte(path)
+    ok, reason = lineage.verify_checkpoint(path)
+    assert not ok and "sha256 mismatch" in reason
+
+
+def test_zip_crc_fallback_without_sidecar(tmp_path):
+    path = _write_npz(str(tmp_path / "3.npz"))
+    ok, reason = lineage.verify_checkpoint(path)
+    assert ok and "no sidecar" in reason
+    corrupt_byte(path)
+    ok, _ = lineage.verify_checkpoint(path)
+    assert not ok
+
+
+def test_truncated_and_empty_checkpoints_rejected(tmp_path):
+    path = _write_npz(str(tmp_path / "6.npz"))
+    lineage.write_sidecar(path)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+    ok, _ = lineage.verify_checkpoint(path)
+    assert not ok
+    empty = str(tmp_path / "9.npz")
+    open(empty, "wb").close()
+    assert lineage.verify_checkpoint(empty) == (False, "empty file")
+    assert lineage.verify_checkpoint(str(tmp_path / "12.npz"))[0] is False  # missing
+
+
+def test_last_good_walks_back_past_rot(tmp_path):
+    d = str(tmp_path)
+    for step in (3, 6, 9):
+        lineage.write_sidecar(_write_npz(os.path.join(d, f"{step}.npz")))
+    lineage.mark_last_good(d, 9)
+    assert lineage.last_good_checkpoint(d).endswith("9.npz")
+    corrupt_byte(os.path.join(d, "9.npz"))
+    assert lineage.last_good_checkpoint(d).endswith("6.npz")
+    corrupt_byte(os.path.join(d, "6.npz"))
+    assert lineage.last_good_checkpoint(d).endswith("3.npz")
+    corrupt_byte(os.path.join(d, "3.npz"))
+    assert lineage.last_good_checkpoint(d) is None
+
+
+def test_last_good_never_returns_unblessed_newer(tmp_path):
+    d = str(tmp_path)
+    for step in (3, 6):
+        lineage.write_sidecar(_write_npz(os.path.join(d, f"{step}.npz")))
+    lineage.mark_last_good(d, 3)
+    # 6.npz verifies fine but was never blessed (e.g. written while the
+    # sentinel was unhealthy) — the pointer bounds the walk
+    assert lineage.last_good_checkpoint(d).endswith("3.npz")
+
+
+def test_retention_protects_last_good(tmp_path):
+    d = str(tmp_path)
+    for step in (1, 2, 3, 4, 5):
+        lineage.write_sidecar(_write_npz(os.path.join(d, f"{step}.npz")))
+    lineage.mark_last_good(d, 2)
+    deleted = lineage.apply_retention(d, keep=2)
+    assert lineage.checkpoint_steps(d) == [2, 4, 5]
+    assert all(os.path.basename(p).startswith(("1.", "3.")) for p in deleted)
+    assert not os.path.exists(os.path.join(d, "1.npz.sha256"))
+    assert lineage.apply_retention(d, keep=0) == []  # 0 keeps everything
+
+
+def test_finalize_save_blessing_rules(tmp_path):
+    d = str(tmp_path)
+    p3 = _write_npz(os.path.join(d, "3.npz"))
+    assert lineage.finalize_save(d, p3, 3, healthy=True, keep=0)
+    assert lineage.last_good_step(d) == 3
+    # unhealthy save verifies but is not blessed
+    p6 = _write_npz(os.path.join(d, "6.npz"))
+    assert lineage.finalize_save(d, p6, 6, healthy=False, keep=0)
+    assert lineage.last_good_step(d) == 3
+    # corrupt-after-sidecar (the SAT_FI_CORRUPT_CKPT_STEP window): the
+    # early hash pins the intended bytes, so the verify must fail and
+    # the pointer must hold
+    p9 = _write_npz(os.path.join(d, "9.npz"))
+    lineage.write_sidecar(p9)
+    corrupt_byte(p9)
+    assert not lineage.finalize_save(d, p9, 9, healthy=True, keep=0)
+    assert lineage.last_good_step(d) == 3
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-layer restore + latest_checkpoint hygiene (fake states: no
+# model init, the contracts are pure host IO)
+# ---------------------------------------------------------------------------
+
+
+class FakeState(NamedTuple):
+    params: Dict
+    batch_stats: Dict
+    opt_state: Dict
+    step: np.ndarray
+
+    def _replace_step(self, step):  # pragma: no cover - readability alias
+        return self._replace(step=step)
+
+
+def _fake_state(step, value=0.0):
+    return FakeState(
+        params={"w": np.full((4,), value, np.float32)},
+        batch_stats={},
+        opt_state={"mu": {"w": np.full((4,), value / 10.0, np.float32)}},
+        step=np.asarray(step, np.int32),
+    )
+
+
+def test_latest_checkpoint_skips_temp_partial_and_foreign_files(tmp_path):
+    config = Config(save_dir=str(tmp_path))
+    for step, value in ((3, 1.0), (6, 2.0)):
+        save_checkpoint(_fake_state(step, value), config)
+    # junk a preempted/misbehaving process could leave behind
+    open(str(tmp_path / "9.npz.tmp"), "wb").write(b"partial")
+    open(str(tmp_path / "12.npz"), "wb").close()  # zero-byte torn write
+    open(str(tmp_path / "slim.npz"), "wb").write(b"trimmed-for-eval")
+    os.mkdir(str(tmp_path / "15.npz"))
+    open(str(tmp_path / "tmpab12.tmp"), "wb").write(b"x")
+    assert latest_checkpoint(str(tmp_path)).endswith("6.npz")
+
+
+def test_restore_walks_back_past_corrupt_and_truncated(tmp_path, capsys):
+    config = Config(save_dir=str(tmp_path))
+    for step, value in ((3, 1.0), (6, 2.0), (9, 3.0)):
+        save_checkpoint(_fake_state(step, value), config)
+    corrupt_byte(str(tmp_path / "9.npz"))
+    with open(str(tmp_path / "6.npz"), "r+b") as f:
+        f.truncate(os.path.getsize(str(tmp_path / "6.npz")) // 3)
+    restored, count = restore_checkpoint(_fake_state(0), save_dir=str(tmp_path))
+    assert count == 2  # params/w + optimizer mu/w
+    assert int(restored.step) == 3
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.full((4,), 1.0))
+    err = capsys.readouterr().err
+    assert "9.npz" in err and "6.npz" in err and "walking back" in err
+
+
+def test_restore_raises_when_nothing_verifiable(tmp_path):
+    config = Config(save_dir=str(tmp_path))
+    save_checkpoint(_fake_state(3, 1.0), config)
+    corrupt_byte(str(tmp_path / "3.npz"))
+    with pytest.raises(FileNotFoundError, match="no verifiable checkpoint"):
+        restore_checkpoint(_fake_state(0), save_dir=str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# anomaly sentinel (pure host-float decisions)
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_off_ignores_everything():
+    s = AnomalySentinel("off")
+    assert s.check(1, {"loss": float("nan")}) == "ok"
+    assert s.healthy and not s.suppress_save
+
+
+def test_sentinel_warn_reports_and_recovers():
+    s = AnomalySentinel("warn")
+    assert s.check(1, {"loss": 2.0}) == "ok"
+    assert s.check(2, {"loss": float("nan")}) == "warn"
+    assert not s.healthy and not s.suppress_save  # warn never blocks saves
+    assert s.check(3, {"loss": float("inf")}) == "warn"
+    assert s.check(4, {"loss": 2.0}) == "ok"  # self-recovered
+    assert s.healthy and s.anomalies == 2
+
+
+def test_sentinel_skip_suppresses_saves_while_unhealthy():
+    s = AnomalySentinel("skip")
+    assert s.check(1, {"loss": float("nan")}) == "skip"
+    assert s.suppress_save
+    assert s.check(2, {"loss": 1.0}) == "ok"
+    assert not s.suppress_save
+
+
+def test_sentinel_rollback_budget_degrades_to_warn():
+    s = AnomalySentinel("rollback")
+    for _ in range(MAX_ROLLBACKS):
+        assert s.check(1, {"loss": float("nan")}) == "rollback"
+        s.note_rolled_back()
+        assert s.healthy
+    assert s.check(2, {"loss": float("nan")}) == "warn"
+    assert s.rollbacks == MAX_ROLLBACKS
+
+
+def test_sentinel_loss_spike_detection():
+    s = AnomalySentinel("warn", spike_factor=10.0)
+    for step in range(1, 6):
+        assert s.check(step, {"loss": 2.0}) == "ok"
+    assert s.check(6, {"loss": 50.0}) == "warn"  # 25x the running mean
+    assert "spiked" in s.last_reason
+    # the spike did not drag the EMA up: a second spike still trips
+    assert s.check(7, {"loss": 50.0}) == "warn"
+
+
+# ---------------------------------------------------------------------------
+# graceful shutdown
+# ---------------------------------------------------------------------------
+
+
+def test_graceful_shutdown_catches_sigterm_and_restores_handler():
+    before = signal.getsignal(signal.SIGTERM)
+    with GracefulShutdown() as s:
+        assert not s.stop_requested
+        signal.raise_signal(signal.SIGTERM)
+        deadline = time.time() + 5.0
+        while not s.stop_requested and time.time() < deadline:
+            time.sleep(0.01)
+        assert s.stop_requested
+        assert s.signal_name == "SIGTERM"
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+# ---------------------------------------------------------------------------
+# summary writer degradation
+# ---------------------------------------------------------------------------
+
+
+def test_summary_writer_close_idempotent_and_post_close_writes_noop(tmp_path):
+    from sat_tpu.utils.summary import SummaryWriter
+
+    w = SummaryWriter(str(tmp_path))
+    w.scalars(1, {"loss": 1.0})
+    w.close()
+    w.close()  # second close must not raise (with-block + ExitStack both hit it)
+    w.scalars(2, {"loss": 2.0})  # post-close writes are silently dropped
+    w.flush()
+    lines = open(str(tmp_path / "metrics.jsonl")).read().strip().splitlines()
+    assert len(lines) == 1
+
+
+def test_summary_writer_degrades_on_io_failure(tmp_path, capsys):
+    from sat_tpu.utils.summary import SummaryWriter
+
+    w = SummaryWriter(str(tmp_path))
+    w._events.close()  # simulate the filesystem yanking the handle
+    w._jsonl.close()
+    w.scalars(1, {"loss": 1.0})  # must warn, not raise
+    w.scalars(2, {"loss": 2.0})
+    w.close()
+    err = capsys.readouterr().err
+    assert err.count("summary writer disabled") == 1  # warned exactly once
+
+
+# ---------------------------------------------------------------------------
+# fault-injection plan plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_inert_by_default_and_parses_env():
+    assert FaultPlan.from_env({}).inert
+    plan = FaultPlan.from_env(
+        {"SAT_FI_DIE_AT_STEP": "5", "SAT_FI_NAN_AT_STEP": "7"}
+    )
+    assert not plan.inert
+    assert plan.die_at_step == 5 and plan.nan_at_step == 7
+    with pytest.raises(ValueError, match="expected an integer"):
+        FaultPlan.from_env({"SAT_FI_DIE_AT_STEP": "soon"})
+
+
+def test_fault_plan_die_fires_exactly_once():
+    plan = FaultPlan(die_at_step=3)
+    plan.maybe_kill(2)  # below threshold: nothing
+    with pytest.raises(SimulatedPreemption):
+        plan.maybe_kill(3)
+    plan.maybe_kill(4)  # fired already: the 'process' died once
+
+
+# ---------------------------------------------------------------------------
+# end-to-end recovery paths through runtime.train (tiny model; compile
+# cache shared with the runtime tests keeps these fast)
+# ---------------------------------------------------------------------------
+
+
+def _cfg(coco_fixture, tmp_path, name, **kw):
+    return coco_fixture["config"].replace(
+        **{
+            **SMALL_MODEL,
+            "save_dir": str(tmp_path / name),
+            "summary_dir": str(tmp_path / (name + "_s")),
+            **kw,
+        }
+    )
+
+
+def test_injected_preemption_resume_bitwise_matches_control(
+    coco_fixture, tmp_path, monkeypatch
+):
+    """SAT_FI_DIE_AT_STEP=k: the run dies abruptly, resume from the last
+    periodic checkpoint replays to a bitwise-identical final state."""
+    want = runtime.train(_cfg(coco_fixture, tmp_path, "control"))
+    assert int(want.step) == 6
+
+    cfg = _cfg(coco_fixture, tmp_path, "preempted")
+    monkeypatch.setenv("SAT_FI_DIE_AT_STEP", "5")
+    with pytest.raises(SimulatedPreemption):
+        runtime.train(cfg)
+    monkeypatch.delenv("SAT_FI_DIE_AT_STEP")
+    # steps 4-5 ran but died before any later save: 3.npz is the survivor
+    assert latest_checkpoint(cfg.save_dir).endswith("3.npz")
+    assert lineage.last_good_step(cfg.save_dir) == 3
+
+    state = runtime.setup_state(cfg, load=True)
+    assert int(state.step) == 3
+    state = runtime.train(cfg, state=state)
+    assert int(state.step) == 6
+
+    got, ref = state_to_flat(state), state_to_flat(want)
+    assert set(got) == set(ref)
+    for k in ref:
+        np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+
+
+def test_injected_sigterm_stops_gracefully_with_final_checkpoint(
+    coco_fixture, tmp_path, monkeypatch, capsys
+):
+    """SAT_FI_SIGTERM_AT_STEP=k: train() returns normally at the next step
+    boundary with the final checkpoint flushed and blessed."""
+    cfg = _cfg(coco_fixture, tmp_path, "sigterm")
+    monkeypatch.setenv("SAT_FI_SIGTERM_AT_STEP", "4")
+    state = runtime.train(cfg)
+    assert int(state.step) == 4  # stopped at the boundary, not mid-epoch end
+    assert latest_checkpoint(cfg.save_dir).endswith("4.npz")
+    assert lineage.last_good_step(cfg.save_dir) == 4
+    err = capsys.readouterr().err
+    assert "SIGTERM" in err and "relaunch with --load" in err
+    monkeypatch.delenv("SAT_FI_SIGTERM_AT_STEP")
+    resumed = runtime.setup_state(cfg, load=True)
+    assert int(resumed.step) == 4
+
+
+def test_injected_nan_warn_policy_withholds_blessing(
+    coco_fixture, tmp_path, monkeypatch
+):
+    """policy=warn: training continues, poisoned checkpoints still land,
+    but LAST_GOOD stays at the last clean save."""
+    cfg = _cfg(coco_fixture, tmp_path, "nanwarn", anomaly_policy="warn")
+    monkeypatch.setenv("SAT_FI_NAN_AT_STEP", "4")
+    state = runtime.train(cfg)
+    assert int(state.step) == 6
+    flat = state_to_flat(state)
+    assert any(
+        not np.all(np.isfinite(v))
+        for k, v in flat.items()
+        if k.startswith("params/") and np.asarray(v).dtype.kind == "f"
+    )
+    assert latest_checkpoint(cfg.save_dir).endswith("6.npz")  # still written
+    assert lineage.last_good_step(cfg.save_dir) == 3  # but never blessed
+    assert lineage.last_good_checkpoint(cfg.save_dir).endswith("3.npz")
+
+
+def test_injected_nan_skip_policy_suppresses_writes(
+    coco_fixture, tmp_path, monkeypatch, capsys
+):
+    """policy=skip: no checkpoint churn while unhealthy — the poisoned
+    tail (including the final save) never reaches disk."""
+    cfg = _cfg(coco_fixture, tmp_path, "nanskip", anomaly_policy="skip")
+    monkeypatch.setenv("SAT_FI_NAN_AT_STEP", "4")
+    state = runtime.train(cfg)
+    assert int(state.step) == 6
+    assert latest_checkpoint(cfg.save_dir).endswith("3.npz")
+    assert lineage.checkpoint_steps(cfg.save_dir) == [3]
+    assert "final checkpoint suppressed" in capsys.readouterr().err
+
+
+def test_injected_nan_rollback_policy_recovers(
+    coco_fixture, tmp_path, monkeypatch
+):
+    """policy=rollback: restore LAST_GOOD, skip the poison window, finish
+    the epoch with finite params and a blessed final checkpoint."""
+    cfg = _cfg(coco_fixture, tmp_path, "nanroll", anomaly_policy="rollback")
+    monkeypatch.setenv("SAT_FI_NAN_AT_STEP", "4")
+    state = runtime.train(cfg)
+    assert int(state.step) == 6
+    flat = state_to_flat(state)
+    for name, value in flat.items():
+        if np.asarray(value).dtype.kind == "f":
+            assert np.all(np.isfinite(value)), name
+    assert lineage.last_good_step(cfg.save_dir) == 6
+
+
+def test_injected_checkpoint_corruption_not_blessed(
+    coco_fixture, tmp_path, monkeypatch
+):
+    """SAT_FI_CORRUPT_CKPT_STEP=k: the byte flipped between write and
+    verify is caught; LAST_GOOD skips the rotten file and restore walks
+    past it."""
+    cfg = _cfg(coco_fixture, tmp_path, "rot")
+    monkeypatch.setenv("SAT_FI_CORRUPT_CKPT_STEP", "3")
+    state = runtime.train(cfg)
+    assert int(state.step) == 6
+    assert not lineage.verify_checkpoint(os.path.join(cfg.save_dir, "3.npz"))[0]
+    assert lineage.last_good_step(cfg.save_dir) == 6
+    resumed = runtime.setup_state(cfg, load=True)
+    assert int(resumed.step) == 6
+
+
+def test_keep_checkpoints_retention_through_train(coco_fixture, tmp_path):
+    """--keep_checkpoints through the real loop: old files rotate out,
+    the newest N plus LAST_GOOD survive."""
+    cfg = _cfg(
+        coco_fixture, tmp_path, "keep", save_period=1, keep_checkpoints=2
+    )
+    state = runtime.train(cfg)
+    assert int(state.step) == 6
+    assert lineage.checkpoint_steps(cfg.save_dir) == [5, 6]
