@@ -113,6 +113,24 @@ def build_config(argv: Optional[List[str]] = None):
              "jittered exponential backoff (default 3; 0 disables)",
     )
     p.add_argument(
+        "--telemetry", action="store_true",
+        help="enable host-side span tracing: per-phase step-time "
+             "breakdown at end of run, heartbeat.json run-health file, "
+             "telemetry.jsonl snapshots, Chrome trace JSON "
+             "(docs/OBSERVABILITY.md; adds no device syncs)",
+    )
+    p.add_argument(
+        "--heartbeat_interval", type=float, default=None, metavar="SEC",
+        help="seconds between heartbeat.json rewrites when --telemetry is "
+             "on (default 10; 0 disables the heartbeat thread)",
+    )
+    p.add_argument(
+        "--trace_export", default=None, metavar="PATH",
+        help="Chrome trace-event JSON output path (default "
+             "<summary_dir>/telemetry/trace.json when --telemetry is on); "
+             "load in Perfetto or chrome://tracing",
+    )
+    p.add_argument(
         "--config", default=None, metavar="JSON",
         help="load a Config JSON (e.g. the save_dir sidecar a checkpoint "
              "rode with) as the base instead of built-in defaults; "
@@ -164,6 +182,12 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(keep_checkpoints=args.keep_checkpoints)
     if args.io_retries is not None:
         config = config.replace(io_retries=args.io_retries)
+    if args.telemetry:
+        config = config.replace(telemetry=True)
+    if args.heartbeat_interval is not None:
+        config = config.replace(heartbeat_interval=args.heartbeat_interval)
+    if args.trace_export is not None:
+        config = config.replace(trace_export=args.trace_export)
     overrides = {}
     for item in args.set:
         if "=" not in item:

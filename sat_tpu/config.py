@@ -95,6 +95,24 @@ class Config:
     io_retries: int = 3
     io_retry_base_s: float = 0.05
 
+    # ---- telemetry (docs/OBSERVABILITY.md; no reference equivalent) ----
+    # Host-side span tracing + run-health heartbeat.  Off by default:
+    # when off the telemetry layer is a null object and run behavior is
+    # bit-for-bit what it was before instrumentation.
+    telemetry: bool = False
+    # artifact directory for heartbeat.json / telemetry.jsonl /
+    # breakdown.json ("" = alongside summary_dir's metrics.jsonl)
+    telemetry_dir: str = ""
+    # seconds between heartbeat.json rewrites (0 disables the heartbeat
+    # thread; spans/counters still record)
+    heartbeat_interval: float = 10.0
+    # Chrome trace-event JSON output path ("" = <telemetry_dir>/trace.json
+    # when telemetry is on)
+    trace_export: str = ""
+    # span ring-buffer capacity (percentile window; totals are exact
+    # regardless — see sat_tpu/telemetry/spans.py)
+    telemetry_buffer: int = 65536
+
     # ---- dataset-size caps (reference config.py:60-63) ----
     max_train_ann_num: Optional[int] = 1000
     max_eval_ann_num: Optional[int] = 20
@@ -229,6 +247,14 @@ class Config:
             raise ValueError(
                 f"Config.keep_checkpoints={self.keep_checkpoints}: must be >= 0"
             )
+        if self.heartbeat_interval < 0:
+            raise ValueError(
+                f"Config.heartbeat_interval={self.heartbeat_interval}: must be >= 0"
+            )
+        if self.telemetry_buffer <= 0:
+            raise ValueError(
+                f"Config.telemetry_buffer={self.telemetry_buffer}: must be > 0"
+            )
 
     def replace(self, **kw: Any) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -274,6 +300,7 @@ class Config:
     LOG_PATH_FIELDS = (
         "save_dir", "summary_dir", "profile_dir", "eval_result_dir",
         "eval_result_file", "test_result_dir", "test_result_file",
+        "telemetry_dir", "trace_export",
     )
 
     def apply_env_paths(self) -> "Config":

@@ -26,6 +26,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from ..config import Config
+from .. import telemetry
 from .coco import CocoCaptions
 from .vocabulary import Vocabulary
 
@@ -68,6 +69,7 @@ class DataSet:
         sequence of an uninterrupted one (the reference's stateful
         shuffle-on-reset, dataset.py:37-41, cannot resume mid-stream)."""
         self.epoch = epoch
+        telemetry.gauge("data/epoch", epoch)
         rng = np.random.default_rng((self.seed, epoch))
         self.idxs = (
             list(rng.permutation(self.count))

@@ -26,6 +26,8 @@ from typing import Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from .. import telemetry
+
 # Spatial mean of the Caffe ILSVRC-2012 mean image (BGR npy channel order);
 # matches np.load('ilsvrc_2012_mean.npy').mean(1).mean(1) in the reference.
 ILSVRC_2012_MEAN = np.array([104.00698793, 116.66876762, 122.67891434], np.float32)
@@ -123,6 +125,10 @@ class PrefetchLoader:
             )
 
     def _decode_batch(self, batch, pool: ThreadPoolExecutor):
+        with telemetry.span("data/decode_batch"):
+            return self._decode_batch_inner(batch, pool)
+
+    def _decode_batch_inner(self, batch, pool: ThreadPoolExecutor):
         if isinstance(batch, tuple):
             files, word_idxs, masks = batch
             out = {
@@ -183,6 +189,9 @@ class PrefetchLoader:
         try:
             while True:
                 item = q.get()
+                # depth AFTER the take: 0 = consumer outran the producers
+                # (data-starved), maxsize = producers ahead (healthy)
+                telemetry.get().gauge("data/prefetch_qsize", q.qsize())
                 if item is sentinel:
                     if error:
                         raise error[0]

@@ -46,6 +46,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..resilience.retry import retry_io
+from .. import telemetry
 from ..utils.fileio import atomic_write, read_text
 
 MANIFEST_NAME = "manifest.json"
@@ -194,31 +195,33 @@ class ShardCache:
         (live decode); with no fallback a miss raises KeyError so a
         mis-wired cache can't silently emit garbage.
         """
-        S = self.image_size
-        out = np.empty((len(image_files), S, S, 3), np.uint8)
-        by_shard: Dict[int, List[int]] = {}
-        rows: List[int] = [0] * len(image_files)
-        misses: List[int] = []
-        for i, f in enumerate(image_files):
-            entry = self._entries.get(_key(f))
-            if entry is None:
-                misses.append(i)
-                continue
-            by_shard.setdefault(entry[0], []).append(i)
-            rows[i] = entry[1]
-        for shard_idx, positions in by_shard.items():
-            mm = self._shard(shard_idx)
-            out[positions] = mm[[rows[i] for i in positions]]
-        if misses:
-            if fallback is None:
-                raise KeyError(
-                    f"{len(misses)} image(s) not in shard cache "
-                    f"{self.cache_dir} and no live-decode fallback given "
-                    f"(first: {image_files[misses[0]]!r})"
-                )
-            for i in misses:
-                out[i] = fallback(str(image_files[i]))
-        return out
+        with telemetry.span("data/shard_gather"):
+            S = self.image_size
+            out = np.empty((len(image_files), S, S, 3), np.uint8)
+            by_shard: Dict[int, List[int]] = {}
+            rows: List[int] = [0] * len(image_files)
+            misses: List[int] = []
+            for i, f in enumerate(image_files):
+                entry = self._entries.get(_key(f))
+                if entry is None:
+                    misses.append(i)
+                    continue
+                by_shard.setdefault(entry[0], []).append(i)
+                rows[i] = entry[1]
+            for shard_idx, positions in by_shard.items():
+                mm = self._shard(shard_idx)
+                out[positions] = mm[[rows[i] for i in positions]]
+            if misses:
+                if fallback is None:
+                    raise KeyError(
+                        f"{len(misses)} image(s) not in shard cache "
+                        f"{self.cache_dir} and no live-decode fallback given "
+                        f"(first: {image_files[misses[0]]!r})"
+                    )
+                telemetry.count("data/decode_fallback", len(misses))
+                for i in misses:
+                    out[i] = fallback(str(image_files[i]))
+            return out
 
 
 # ---------------------------------------------------------------------------

@@ -16,9 +16,10 @@ only hides the real bug — while errors that signal *transient transport
 trouble* back off and retry.  Everything that is not an OSError at all
 propagates untouched.
 
-No jax, no sat_tpu imports beyond ``faultinject`` (the injection point
-``SAT_FI_IO_FAILURES`` lands here), so the wrapper is usable from
-host-only tools like ``scripts/bench_ckpt.py``.
+No jax, and no sat_tpu imports beyond ``faultinject`` (the injection
+point ``SAT_FI_IO_FAILURES`` lands here) and the equally jax-free
+``telemetry`` (each retry ticks the ``io/retries`` counter), so the
+wrapper is usable from host-only tools like ``scripts/bench_ckpt.py``.
 """
 
 from __future__ import annotations
@@ -30,6 +31,7 @@ import time
 from typing import Callable, Optional, Tuple, TypeVar
 
 from .faultinject import consume_io_fault
+from .. import telemetry
 
 T = TypeVar("T")
 
@@ -113,6 +115,7 @@ def retry_io(
         except BaseException as e:
             if not is_retryable(e) or attempt == budget:
                 raise
+            telemetry.count("io/retries")
             delay = min(base * (2.0 ** attempt), max_delay_s)
             delay *= _jitter_rng.uniform(*jitter)
             print(

@@ -33,6 +33,8 @@ import math
 import sys
 from typing import Dict, Optional
 
+from .. import telemetry
+
 # A diverging run that keeps tripping rollback would otherwise loop
 # forever restoring the same checkpoint; after this many restores the
 # sentinel degrades to `warn` and lets the run fail visibly.
@@ -106,6 +108,7 @@ class AnomalySentinel:
             self.healthy = True
             return "ok"
         self.anomalies += 1
+        telemetry.count("sentinel/anomalies")
         self.healthy = False
         self.last_reason = reason
         action = self.policy
@@ -119,6 +122,7 @@ class AnomalySentinel:
                 )
                 return "warn"
             self.rollbacks += 1
+            telemetry.count("sentinel/rollbacks")
         print(
             f"sat_tpu: ANOMALY at step {step}: {reason} (policy={action})",
             file=sys.stderr,

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -43,6 +44,7 @@ from .resilience import AnomalySentinel, FaultPlan, GracefulShutdown, lineage
 from .resilience import retry as _retry
 from .resilience.lineage import CheckpointWriteError
 from .train.step import TrainState, create_train_state, make_jit_train_step
+from . import telemetry
 from .utils.fileio import atomic_write
 from .utils.progress import Progress, track
 from .utils.summary import SummaryWriter
@@ -87,10 +89,14 @@ def device_prefetch(loader, ahead: int = 1):
     from collections import deque
 
     def put(batch):
-        return {
-            k: jax.device_put(v) if isinstance(v, np.ndarray) else v
-            for k, v in batch.items()
-        }
+        # the span times only the (async) transfer DISPATCH — it runs
+        # inside the feed's data wait, so the breakdown reports it as a
+        # nested interval, not a phase of its own
+        with telemetry.span("feed/device_put"):
+            return {
+                k: jax.device_put(v) if isinstance(v, np.ndarray) else v
+                for k, v in batch.items()
+            }
 
     buf = deque()
     for batch in loader:
@@ -196,6 +202,107 @@ class ProfilerWindow:
             jax.profiler.stop_trace()
             self._on = False
         self._last_sync = None
+
+
+# ---------------------------------------------------------------------------
+# telemetry wiring (docs/OBSERVABILITY.md)
+# ---------------------------------------------------------------------------
+
+# train-loop phase decomposition: disjoint sub-intervals of "train/step"
+# (their totals + the "other" residual reconstruct measured wall time) and
+# the nested spans that occur INSIDE a phase (reported, not summed)
+_TRAIN_PHASES = (
+    "train/data_wait", "train/dispatch", "train/log_sync",
+    "train/summary", "train/checkpoint",
+)
+_TRAIN_NESTED = ("feed/device_put", "ckpt/write", "ckpt/snapshot")
+_DECODE_PHASES = ("decode/data_wait", "decode/dispatch", "decode/drain")
+_DECODE_NESTED = ("feed/device_put",)
+
+_compile_listener_installed = False
+
+
+def _install_compile_listener() -> None:
+    """Feed XLA compile count/seconds into the active telemetry recorder.
+
+    ``jax.monitoring`` listeners cannot be unregistered, so install ONE
+    process-wide callback that dispatches through ``telemetry.get()`` —
+    re-running train() in the same process (tests, sweeps) never stacks a
+    second listener, and with telemetry off the callback hits the null
+    object."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return
+    _compile_listener_installed = True
+    try:
+        from jax import monitoring
+
+        def _cb(event: str, duration: float, **kw) -> None:
+            if "compil" in event:
+                tel = telemetry.get()
+                tel.count("jax/compiles")
+                tel.count("jax/compile_s", duration)
+
+        monitoring.register_event_duration_secs_listener(_cb)
+    except Exception:
+        pass  # observability never takes the run down
+
+
+def _timed_iter(it, tel, name: str):
+    """Yield from ``it``, recording each ``next()`` wait as a ``name``
+    span — the feed-starvation phase of the consuming loop."""
+    it = iter(it)
+    while True:
+        t0 = time.perf_counter_ns()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        tel.record(name, t0, time.perf_counter_ns() - t0)
+        yield item
+
+
+def _telemetry_dir(config: Config) -> str:
+    return config.telemetry_dir or os.path.join(config.summary_dir, "telemetry")
+
+
+def _telemetry_begin(config: Config):
+    """Install the run's telemetry implementation (fresh buffers when on,
+    the null object when off) and the process-wide compile listener."""
+    if config.telemetry:
+        tel = telemetry.enable(config.telemetry_buffer)
+    else:
+        tel = telemetry.disable()
+    _install_compile_listener()
+    return tel
+
+
+def _telemetry_finish(tel, config: Config, phase: str) -> None:
+    """End-of-run exports: Chrome trace JSON, the per-phase step-time
+    breakdown (printed + saved), run from an ExitStack callback so an
+    interrupted run still leaves its trace behind."""
+    from .telemetry import exporters
+
+    tdir = _telemetry_dir(config)
+    trace_path = config.trace_export or os.path.join(
+        tdir, "trace.json" if phase == "train" else f"trace-{phase}.json"
+    )
+    exporters.export_chrome_trace(tel, trace_path)
+    step_span, phases, nested = (
+        ("train/step", _TRAIN_PHASES, _TRAIN_NESTED)
+        if phase == "train"
+        else ("decode/batch", _DECODE_PHASES, _DECODE_NESTED)
+    )
+    report = exporters.step_breakdown(tel, step_span, phases, nested)
+    if report is not None:
+        print(exporters.format_breakdown(report), flush=True)
+        exporters.save_breakdown(
+            report,
+            os.path.join(
+                tdir,
+                "breakdown.json" if phase == "train" else f"breakdown-{phase}.json",
+            ),
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -311,6 +418,10 @@ def train(
         else None
     )
     ckpt_save = async_writer.save if async_writer else save_checkpoint
+    # host-side tracing (docs/OBSERVABILITY.md): fresh ring buffers when
+    # config.telemetry, the null object otherwise — the off path leaves
+    # run behavior bit-for-bit unchanged
+    tel = _telemetry_begin(config)
     import contextlib
 
     final_path: Optional[str] = None
@@ -320,6 +431,26 @@ def train(
     with contextlib.ExitStack() as _stack, SummaryWriter(
         config.summary_dir
     ) as writer, GracefulShutdown() as shutdown:
+        if tel.enabled:
+            # LIFO: trace/breakdown export runs last, after the heartbeat's
+            # final beat, which itself runs after the async writer drains —
+            # the artifacts see the final step and the final checkpoint
+            _stack.callback(_telemetry_finish, tel, config, "train")
+            if config.heartbeat_interval > 0:
+                from .telemetry.heartbeat import Heartbeat
+
+                hb = Heartbeat(
+                    os.path.join(_telemetry_dir(config), "heartbeat.json"),
+                    config.heartbeat_interval,
+                    tel,
+                    static={
+                        "phase": "train",
+                        "backend": jax.default_backend(),
+                        "num_devices": jax.device_count(),
+                    },
+                )
+                _stack.callback(hb.stop)
+                hb.start()
         if async_writer:
             _stack.callback(async_writer.close)
         # resume-aware trace window (>= start, once); the ExitStack exit
@@ -352,7 +483,13 @@ def train(
                     desc=f"epoch {epoch + 1}/{config.num_epochs}",
                     initial=skip_batches if epoch == start_epoch else 0,
                 )
-                for batch in wrap_feed(loader):
+                # step span boundary: each iteration records data_wait
+                # (inside _timed_iter) + body phases, and the step total
+                # from the previous boundary — no extra syncs, ~1 µs/step
+                step_t0 = time.perf_counter_ns()
+                for batch in _timed_iter(
+                    wrap_feed(loader), tel, "train/data_wait"
+                ):
                     if config.max_steps and step >= config.max_steps:
                         stopped = True
                         break
@@ -364,27 +501,43 @@ def train(
                         stopped = True
                         break
                     prof.before_step(step)
-                    state, metrics = train_step(
-                        state,
-                        place_batch(
-                            {
-                                "images": batch["images"],
-                                "word_idxs": batch["word_idxs"],
-                                "masks": batch["masks"],
-                            }
-                        ),
-                        jax.random.fold_in(root_rng, step),
-                    )
+                    with tel.span("train/dispatch"):
+                        state, metrics = train_step(
+                            state,
+                            place_batch(
+                                {
+                                    "images": batch["images"],
+                                    "word_idxs": batch["word_idxs"],
+                                    "masks": batch["masks"],
+                                }
+                            ),
+                            jax.random.fold_in(root_rng, step),
+                        )
                     prof.after_step(step, state)
                     step += 1  # == int(state.step), without a device sync
+                    tel.gauge("train/step", step)
                     # injected NaN gradient (inert unarmed): poisons params
                     # and metrics exactly as a diverged update would
                     state, metrics = plan.maybe_poison(step, state, metrics)
                     if step % config.log_every == 0:
                         # the loop's ONE host sync — the sentinel reads
                         # these already-fetched floats, adding no syncs
-                        host = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                        with tel.span("train/log_sync"):
+                            host = {
+                                k: float(v)
+                                for k, v in jax.device_get(metrics).items()
+                            }
                         writer.scalars(step, host)
+                        if tel.enabled:
+                            from .telemetry import exporters
+
+                            exporters.append_jsonl(
+                                tel,
+                                os.path.join(
+                                    _telemetry_dir(config), "telemetry.jsonl"
+                                ),
+                                step,
+                            )
                         if sentinel.check(step, host) == "rollback":
                             rollback = True
                             break
@@ -392,14 +545,19 @@ def train(
                         config.var_summary_period
                         and step % config.var_summary_period == 0
                     ):
-                        writer.variable_stats(step, state.params)
+                        with tel.span("train/summary"):
+                            writer.variable_stats(step, state.params)
                     if (
                         config.save_period
                         and step % config.save_period == 0
                         and not sentinel.suppress_save
                     ):
-                        ckpt_save(state, config, healthy=sentinel.healthy)
+                        with tel.span("train/checkpoint"):
+                            ckpt_save(state, config, healthy=sentinel.healthy)
                     bar.update()
+                    now = time.perf_counter_ns()
+                    tel.record("train/step", step_t0, now - step_t0)
+                    step_t0 = now
                 bar.close()
                 if stopped or rollback:
                     break
@@ -688,20 +846,40 @@ def decode_dataset(
         if int(np.prod(config.mesh_shape)) == 1
         else loader
     )
-    with ProfilerWindow(config, max_start=dataset.num_batches - 1) as prof:
-        # per-batch visibility during decode (reference base_model.py:82,131
-        # tqdm-bars eval/test; a full-COCO eval would otherwise run silent)
-        for b, batch in enumerate(
-            track(feed, dataset.num_batches, desc="decode")
-        ):
-            prof.before_step(b)
-            out = run_batch(batch)                 # async dispatch
-            prof.after_step(b, out.words)
-            if prev is not None:
+    # host tracing over the decode loop: data_wait / dispatch / drain per
+    # batch (the drain of batch n overlaps batch n+1's device beam search
+    # — the breakdown shows whether the host decode keeps up)
+    tel = _telemetry_begin(config)
+    try:
+        with ProfilerWindow(config, max_start=dataset.num_batches - 1) as prof:
+            # per-batch visibility during decode (reference
+            # base_model.py:82,131 tqdm-bars eval/test; a full-COCO eval
+            # would otherwise run silent)
+            batch_t0 = time.perf_counter_ns()
+            for b, batch in enumerate(
+                track(
+                    _timed_iter(feed, tel, "decode/data_wait"),
+                    dataset.num_batches,
+                    desc="decode",
+                )
+            ):
+                prof.before_step(b)
+                with tel.span("decode/dispatch"):
+                    out = run_batch(batch)         # async dispatch
+                prof.after_step(b, out.words)
+                if prev is not None:
+                    with tel.span("decode/drain"):
+                        drain(*prev)
+                prev = (out, batch["files"])
+                now = time.perf_counter_ns()
+                tel.record("decode/batch", batch_t0, now - batch_t0)
+                batch_t0 = now
+        if prev is not None:
+            with tel.span("decode/drain"):
                 drain(*prev)
-            prev = (out, batch["files"])
-    if prev is not None:
-        drain(*prev)
+    finally:
+        if tel.enabled:
+            _telemetry_finish(tel, config, "decode")
     return results
 
 

@@ -1,0 +1,42 @@
+"""sat_tpu.telemetry — always-on host-side tracing and run-health metrics.
+
+Complements ``jax.profiler`` (deep, short-windowed, device-centric) with a
+cheap, whole-run, host-centric layer: ring-buffered spans + counters +
+gauges (``spans``), Chrome-trace / JSONL / breakdown exporters
+(``exporters``), and the pollable ``heartbeat.json`` writer
+(``heartbeat``).  See docs/OBSERVABILITY.md.
+
+This package is deliberately jax-free so host-only tools
+(``scripts/bench_telemetry.py``) can use it without an accelerator
+backend.  Only ``spans`` is imported eagerly; runtime imports the
+exporters and heartbeat directly.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from .spans import (  # noqa: F401
+    NULL_SPAN,
+    NULL_TELEMETRY,
+    NullTelemetry,
+    Telemetry,
+    count,
+    disable,
+    enable,
+    enabled,
+    gauge,
+    get,
+    record,
+    span,
+)
+
+# One id per process lifetime: every artifact a run writes (metrics.jsonl,
+# telemetry.jsonl, heartbeat.json, trace JSON) carries it, so post-hoc
+# joins never depend on file mtimes or directory layout.
+RUN_ID = f"{int(time.time()):x}-{os.getpid()}"
+
+
+def run_id() -> str:
+    return RUN_ID

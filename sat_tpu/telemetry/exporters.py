@@ -1,0 +1,259 @@
+"""Telemetry exporters: Chrome trace JSON, telemetry.jsonl, breakdown report.
+
+Three output formats, one source (:class:`~sat_tpu.telemetry.spans.Telemetry`):
+
+* :func:`export_chrome_trace` — trace-event JSON (``ph:"X"`` complete
+  events, microsecond timestamps) loadable in Perfetto /
+  ``chrome://tracing``, one track per recording thread;
+* :func:`append_jsonl` — one JSON line per call (written at ``log_every``
+  boundaries, alongside ``metrics.jsonl``) carrying the counters, gauges,
+  and per-span running totals at that moment;
+* :func:`step_breakdown` / :func:`format_breakdown` — the end-of-run
+  per-phase step-time report (count, total, p50/p95/max) the CLI prints
+  and saves as JSON.  Phases are the *disjoint* decomposition of a step;
+  the residual between the step-total span and the phase sum is reported
+  as the ``other`` phase, so the phase sum always reconstructs measured
+  wall time (docs/OBSERVABILITY.md explains how to read it).
+
+All writers degrade on failure (observability must never kill the run —
+the SummaryWriter rule) and none of them touch jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from ..utils.fileio import atomic_write
+from . import run_id
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event JSON
+# ---------------------------------------------------------------------------
+
+
+def chrome_trace(tel, process_name: str = "sat_tpu host") -> Dict:
+    """The trace-event document for ``tel``'s retained span window.
+
+    Timestamps are microseconds since the recorder's anchor; the absolute
+    anchor (unix seconds) rides in ``otherData`` for post-hoc alignment
+    with ``metrics.jsonl``'s wall-clock stamps.
+    """
+    names, ids, t0s, durs, tids = tel.spans_snapshot()
+    pid = os.getpid()
+    events: List[Dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "args": {"name": process_name},
+        }
+    ]
+    anchor = tel.anchor_ns
+    for k in range(len(ids)):
+        events.append(
+            {
+                "name": names[int(ids[k])],
+                "cat": "host",
+                "ph": "X",
+                "pid": pid,
+                "tid": int(tids[k]),
+                "ts": (int(t0s[k]) - anchor) / 1e3,
+                "dur": int(durs[k]) / 1e3,
+            }
+        )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "run_id": run_id(),
+            "anchor_unix": tel.anchor_unix,
+            "counters": tel.counters(),
+            "gauges": tel.gauges(),
+        },
+    }
+
+
+def export_chrome_trace(tel, path: str) -> Optional[str]:
+    """Write the Perfetto-loadable trace JSON atomically; returns the path
+    (None when the write failed — reported, never raised)."""
+    try:
+        doc = chrome_trace(tel)
+        atomic_write(path, "w", lambda f: json.dump(doc, f))
+        return path
+    except (OSError, ValueError) as e:
+        print(
+            f"sat_tpu: telemetry trace export failed ({path}): {e}",
+            file=sys.stderr,
+            flush=True,
+        )
+        return None
+
+
+# ---------------------------------------------------------------------------
+# periodic telemetry.jsonl
+# ---------------------------------------------------------------------------
+
+
+def snapshot_row(tel, step: Optional[int] = None) -> Dict:
+    """One JSON-able snapshot of the recorder: counters, gauges, and
+    per-span running (count, total ms, max ms) — same stamp fields as
+    ``metrics.jsonl`` rows so the two join on (run_id, step/time)."""
+    spans = {
+        name: {
+            "count": c,
+            "total_ms": round(total / 1e6, 3),
+            "max_ms": round(mx / 1e6, 3),
+        }
+        for name, (c, total, mx) in tel.aggregates().items()
+    }
+    row: Dict = {
+        "run_id": run_id(),
+        "wall_time": round(time.time(), 6),
+        "mono_ns": time.perf_counter_ns(),
+        "counters": tel.counters(),
+        "gauges": tel.gauges(),
+        "spans": spans,
+    }
+    if step is not None:
+        row["step"] = int(step)
+    return row
+
+
+def append_jsonl(tel, path: str, step: Optional[int] = None) -> None:
+    """Append one snapshot row; failures degrade to a one-line warning
+    (tracked by the ``telemetry/export_errors`` counter)."""
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(snapshot_row(tel, step)) + "\n")
+    except (OSError, ValueError) as e:
+        tel.count("telemetry/export_errors")
+        print(
+            f"sat_tpu: telemetry.jsonl append failed ({path}): {e}",
+            file=sys.stderr,
+            flush=True,
+        )
+
+
+# ---------------------------------------------------------------------------
+# step-time breakdown
+# ---------------------------------------------------------------------------
+
+
+def _stats(count: int, total_ns: int, max_ns: int, samples_ns: np.ndarray) -> Dict:
+    out = {
+        "count": int(count),
+        "total_s": round(total_ns / 1e9, 6),
+        "mean_ms": round(total_ns / count / 1e6, 4) if count else 0.0,
+        "max_ms": round(max_ns / 1e6, 4),
+    }
+    if samples_ns.size:
+        p50, p95 = np.percentile(samples_ns, [50, 95])
+        out["p50_ms"] = round(float(p50) / 1e6, 4)
+        out["p95_ms"] = round(float(p95) / 1e6, 4)
+    else:
+        out["p50_ms"] = out["p95_ms"] = None
+    return out
+
+
+def step_breakdown(
+    tel,
+    step_span: str,
+    phases: Iterable[str],
+    nested: Iterable[str] = (),
+) -> Optional[Dict]:
+    """Per-phase step-time report.
+
+    ``step_span`` is the whole-iteration span; ``phases`` are its disjoint
+    sub-intervals (their durations never overlap, so their sum plus the
+    computed ``other`` residual equals the step total).  ``nested`` names
+    spans that occur INSIDE a phase (e.g. ``feed/device_put`` inside the
+    data wait) — reported for visibility but excluded from the sum.
+    Returns None when no steps were recorded.
+    """
+    agg = tel.aggregates()
+    if step_span not in agg:
+        return None
+    steps, wall_ns, max_ns = agg[step_span]
+    report: Dict = {
+        "run_id": run_id(),
+        "step_span": step_span,
+        "steps": steps,
+        "wall_s": round(wall_ns / 1e9, 6),
+        "steps_per_s": round(steps / (wall_ns / 1e9), 3) if wall_ns else 0.0,
+        "step": _stats(steps, wall_ns, max_ns, tel.durations_ns(step_span)),
+    }
+    accounted = 0
+    out_phases: Dict[str, Dict] = {}
+    for name in phases:
+        if name not in agg:
+            continue
+        c, total, mx = agg[name]
+        accounted += total
+        out_phases[name] = _stats(c, total, mx, tel.durations_ns(name))
+    other_ns = max(0, wall_ns - accounted)
+    out_phases["other"] = {
+        "count": steps,
+        "total_s": round(other_ns / 1e9, 6),
+        "mean_ms": round(other_ns / steps / 1e6, 4) if steps else 0.0,
+        "max_ms": None,
+        "p50_ms": None,
+        "p95_ms": None,
+    }
+    report["phases"] = out_phases
+    report["phase_total_s"] = round((accounted + other_ns) / 1e9, 6)
+    report["nested"] = {
+        name: _stats(*agg[name], tel.durations_ns(name))
+        for name in nested
+        if name in agg
+    }
+    report["counters"] = tel.counters()
+    return report
+
+
+def format_breakdown(report: Dict) -> str:
+    """The human-readable report the CLI prints at end of run."""
+    lines = [
+        f"step-time breakdown ({report['step_span']}): "
+        f"{report['steps']} steps in {report['wall_s']:.3f} s wall "
+        f"({report['steps_per_s']:.2f} steps/s)",
+        f"  {'phase':<24} {'total_s':>9} {'share':>7} "
+        f"{'p50_ms':>9} {'p95_ms':>9} {'max_ms':>9}",
+    ]
+    wall = report["wall_s"] or 1.0
+
+    def fmt(v):
+        return f"{v:9.3f}" if isinstance(v, (int, float)) else f"{'-':>9}"
+
+    for name, st in report["phases"].items():
+        share = 100.0 * st["total_s"] / wall
+        lines.append(
+            f"  {name:<24} {st['total_s']:9.3f} {share:6.1f}% "
+            f"{fmt(st['p50_ms'])} {fmt(st['p95_ms'])} {fmt(st['max_ms'])}"
+        )
+    for name, st in report.get("nested", {}).items():
+        lines.append(
+            f"  ({name}: nested)        {st['total_s']:9.3f}         "
+            f"{fmt(st['p50_ms'])} {fmt(st['p95_ms'])} {fmt(st['max_ms'])}"
+        )
+    return "\n".join(lines)
+
+
+def save_breakdown(report: Dict, path: str) -> Optional[str]:
+    try:
+        atomic_write(path, "w", lambda f: json.dump(report, f, indent=2))
+        return path
+    except (OSError, ValueError) as e:
+        print(
+            f"sat_tpu: breakdown export failed ({path}): {e}",
+            file=sys.stderr,
+            flush=True,
+        )
+        return None

@@ -1,0 +1,159 @@
+"""Run-health heartbeat: an atomically replaced JSON file watchers can poll.
+
+A TPU run on preemptible capacity is usually observed from the *outside*
+— a supervisor shell (``scripts/tpu_retry.sh``-style), a bench
+orchestrator, a human with ``watch jq``.  Log files answer "what
+happened"; the heartbeat answers "is it alive RIGHT NOW and how fast":
+one small JSON object (``heartbeat.json``), rewritten in place with
+tmp+rename every ``interval_s`` seconds by a daemon thread, holding
+
+* liveness: ``seq`` (monotone write counter), ``time_unix``, ``pid``;
+* progress: ``step``, ``epoch``, ``steps_per_s`` (measured between
+  heartbeat ticks, not cumulative — a stall shows up within one tick);
+* recoverability: ``last_checkpoint_step`` and ``last_checkpoint_age_s``
+  (how much work a preemption right now would lose);
+* environment: ``backend``, ``rss_mb``, compile count/seconds (fed by the
+  ``jax.monitoring`` listener runtime installs), plus every telemetry
+  counter for one-file diagnosis.
+
+The writer thread must never take the run down: every failure degrades to
+a single warning (SummaryWriter's rule).  No jax imports — device state is
+read exclusively through gauges the instrumented loops already set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from ..utils.fileio import atomic_write
+from . import run_id
+
+
+def _rss_bytes() -> int:
+    """Resident set size; 0 when unknowable (non-Linux without resource)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:
+            return 0
+
+
+class Heartbeat:
+    """Daemon-thread writer of ``heartbeat.json``.
+
+    ``static`` carries fields known at start (backend, phase); everything
+    dynamic is read from ``tel``'s gauges/counters at write time, so the
+    hot loop communicates with the heartbeat exclusively through the
+    telemetry registry — no extra shared state, no extra syncs.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        interval_s: float,
+        tel,
+        static: Optional[Dict] = None,
+    ) -> None:
+        self.path = path
+        self.interval_s = max(0.05, float(interval_s))
+        self._tel = tel
+        self._static = dict(static or {})
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._seq = 0
+        self._prev: Optional[tuple] = None  # (time, step) of the last write
+        self._warned = False
+
+    # -- payload -----------------------------------------------------------
+
+    def _payload(self) -> Dict:
+        gauges = self._tel.gauges()
+        counters = self._tel.counters()
+        now = time.time()
+        step = gauges.get("train/step")
+        steps_per_s = None
+        if step is not None and self._prev is not None:
+            dt = now - self._prev[0]
+            if dt > 0 and step >= self._prev[1]:
+                steps_per_s = round((step - self._prev[1]) / dt, 3)
+        if step is not None:
+            self._prev = (now, step)
+        last_save = gauges.get("ckpt/last_save_unix")
+        payload = {
+            "run_id": run_id(),
+            "seq": self._seq,
+            "pid": os.getpid(),
+            "time_unix": round(now, 3),
+            "interval_s": self.interval_s,
+            "step": int(step) if step is not None else None,
+            "epoch": gauges.get("data/epoch"),
+            "steps_per_s": steps_per_s,
+            "last_checkpoint_step": gauges.get("ckpt/last_save_step"),
+            "last_checkpoint_age_s": (
+                round(now - last_save, 1) if last_save is not None else None
+            ),
+            "compile_count": counters.get("jax/compiles", 0),
+            "compile_seconds": round(counters.get("jax/compile_s", 0.0), 3),
+            "rss_mb": round(_rss_bytes() / (1 << 20), 1),
+            "counters": counters,
+        }
+        payload.update(self._static)
+        return payload
+
+    def write_now(self) -> None:
+        """One atomic write; failures warn once and never raise."""
+        try:
+            payload = self._payload()
+            self._seq += 1
+            atomic_write(
+                self.path, "w", lambda f: json.dump(payload, f, indent=1)
+            )
+        except Exception as e:
+            if not self._warned:
+                self._warned = True
+                print(
+                    f"sat_tpu: heartbeat disabled — write failed "
+                    f"({self.path}): {e}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _run(self) -> None:
+        self.write_now()  # first beat immediately: watchers see the run early
+        while not self._stop.wait(self.interval_s):
+            self.write_now()
+
+    def start(self) -> "Heartbeat":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="sat-heartbeat", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Final beat (so the file records the terminal step) + join."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+        self.write_now()
+
+    def __enter__(self) -> "Heartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

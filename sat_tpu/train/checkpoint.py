@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -39,6 +40,7 @@ from ..config import Config
 from ..resilience import faultinject, lineage
 from ..resilience.lineage import CheckpointWriteError
 from ..resilience.retry import retry_io
+from .. import telemetry
 from ..utils.dist import gather_tree_replicated
 from ..utils.fileio import atomic_write
 
@@ -108,26 +110,27 @@ def state_to_flat(state: Any) -> Dict[str, np.ndarray]:
     Works on mesh-sharded states (single- or multi-process): shards held
     by other hosts are all-gathered first so every process can materialize
     full values (the distributed save path)."""
-    flat: Dict[str, np.ndarray] = {}
-    flat.update(flatten_with_names(state.params, "params/"))
-    if state.batch_stats:
-        flat.update(flatten_with_names(state.batch_stats, "batch_stats/"))
-    flat.update(flatten_with_names(state.opt_state, "optimizer/"))
-    flat["global_step"] = np.asarray(state.step)
-    flat = gather_tree_replicated(flat)
-    # One batched D2H transfer for the whole dict, not one per leaf.  The
-    # snapshot must OWN its bytes: on the CPU backend device_get returns
-    # zero-copy views of the live device buffers, and those buffers are
-    # donated into the next dispatched step (train/step.py donate_argnums)
-    # — an async writer serializing a view after donation would persist
-    # whatever XLA wrote over it (observed as denormal garbage in Adam mu
-    # slots of resumed runs).  OWNDATA is False exactly for such views, so
-    # TPU-path arrays (device_get already copied) aren't copied twice.
-    host = jax.device_get(flat)
-    return {
-        k: v if isinstance(v, np.ndarray) and v.flags["OWNDATA"] else np.array(v)
-        for k, v in host.items()
-    }
+    with telemetry.span("ckpt/snapshot"):
+        flat: Dict[str, np.ndarray] = {}
+        flat.update(flatten_with_names(state.params, "params/"))
+        if state.batch_stats:
+            flat.update(flatten_with_names(state.batch_stats, "batch_stats/"))
+        flat.update(flatten_with_names(state.opt_state, "optimizer/"))
+        flat["global_step"] = np.asarray(state.step)
+        flat = gather_tree_replicated(flat)
+        # One batched D2H transfer for the whole dict, not one per leaf.  The
+        # snapshot must OWN its bytes: on the CPU backend device_get returns
+        # zero-copy views of the live device buffers, and those buffers are
+        # donated into the next dispatched step (train/step.py donate_argnums)
+        # — an async writer serializing a view after donation would persist
+        # whatever XLA wrote over it (observed as denormal garbage in Adam mu
+        # slots of resumed runs).  OWNDATA is False exactly for such views, so
+        # TPU-path arrays (device_get already copied) aren't copied twice.
+        host = jax.device_get(flat)
+        return {
+            k: v if isinstance(v, np.ndarray) and v.flags["OWNDATA"] else np.array(v)
+            for k, v in host.items()
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -265,14 +268,16 @@ def _write_flat(
     and keep-N retention (docs/RESILIENCE.md)."""
     step = int(flat["global_step"])
     # write through the file object: np.savez(path) appends '.npz' itself
-    retry_io(
-        lambda: atomic_write(path, "wb", lambda f: np.savez(f, **flat)),
-        desc=f"write checkpoint {path}",
-    )
+    with telemetry.span("ckpt/write"):
+        retry_io(
+            lambda: atomic_write(path, "wb", lambda f: np.savez(f, **flat)),
+            desc=f"write checkpoint {path}",
+        )
     # hash NOW, while the file is still exactly what we serialized: a
     # sidecar computed later would faithfully fingerprint whatever rot
     # happened in between and the verify would bless corrupt bytes
-    lineage.write_sidecar(path)
+    with telemetry.span("ckpt/sidecar"):
+        lineage.write_sidecar(path)
     retry_io(
         lambda: config.replace(global_step=step).save(
             os.path.join(save_dir, "config.json")
@@ -282,9 +287,14 @@ def _write_flat(
     # injection point: bit-rot between the rename and the verify — the
     # post-write verify below must catch it and refuse to bless the file
     faultinject.FaultPlan.from_env().maybe_corrupt_checkpoint(path, step)
-    lineage.finalize_save(
-        save_dir, path, step, healthy=healthy, keep=config.keep_checkpoints
-    )
+    # verify + LAST_GOOD advance + retention, timed as one phase
+    with telemetry.span("ckpt/finalize"):
+        lineage.finalize_save(
+            save_dir, path, step, healthy=healthy, keep=config.keep_checkpoints
+        )
+    telemetry.count("ckpt/saves")
+    telemetry.gauge("ckpt/last_save_step", step)
+    telemetry.gauge("ckpt/last_save_unix", time.time())
 
 
 def save_checkpoint(
@@ -390,6 +400,7 @@ def restore_checkpoint(
                 except (OSError, ValueError) as e:  # verified yet unloadable
                     reason = f"load failed: {e}"
             rejected.append(f"{os.path.basename(path)} ({reason})")
+            telemetry.count("ckpt/walkbacks")
             print(
                 f"sat_tpu: checkpoint {path} rejected ({reason}); "
                 "walking back to an older checkpoint",

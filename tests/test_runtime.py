@@ -217,10 +217,17 @@ def test_summary_writer_tfevents_roundtrip(tmp_path):
     assert struct.pack("<f", 2.5) in records[1]
 
     rows = [json.loads(x) for x in open(tmp_path / "metrics.jsonl")]
-    assert rows[0] == {"step": 1, "loss": 2.5, "acc": 0.5}
+    assert rows[0]["step"] == 1 and rows[0]["loss"] == 2.5 and rows[0]["acc"] == 0.5
     # non-finite values can't enter the tfevents wire format but must
     # still leave a trace of the divergence in metrics.jsonl (ADVICE r1)
-    assert rows[1] == {"step": 2, "acc": 1.0, "loss": "nan"}
+    assert rows[1]["step"] == 2 and rows[1]["acc"] == 1.0 and rows[1]["loss"] == "nan"
+    # every row is stamped for post-hoc joins (docs/OBSERVABILITY.md)
+    for row in rows:
+        assert isinstance(row["wall_time"], float)
+        assert isinstance(row["mono_ns"], int)
+        assert isinstance(row["run_id"], str) and row["run_id"]
+    assert rows[0]["run_id"] == rows[1]["run_id"]
+    assert rows[1]["mono_ns"] >= rows[0]["mono_ns"]
 
 
 def _decode_histo(histo: bytes):
