@@ -125,6 +125,14 @@ def build_config(argv: Optional[List[str]] = None):
              "on (default 10; 0 disables the heartbeat thread)",
     )
     p.add_argument(
+        "--diag_level", default=None, choices=("off", "basic", "full"),
+        help="in-graph model-health taps (grad/update/param norms, "
+             "attention entropy, alpha-coverage deviation, logit max) "
+             "merged into the train metrics at the existing log sync — "
+             "zero extra device syncs; 'full' adds per-layer-group norms "
+             "(docs/OBSERVABILITY.md)",
+    )
+    p.add_argument(
         "--trace_export", default=None, metavar="PATH",
         help="Chrome trace-event JSON output path (default "
              "<summary_dir>/telemetry/trace.json when --telemetry is on); "
@@ -188,6 +196,8 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(heartbeat_interval=args.heartbeat_interval)
     if args.trace_export is not None:
         config = config.replace(trace_export=args.trace_export)
+    if args.diag_level is not None:
+        config = config.replace(diag_level=args.diag_level)
     overrides = {}
     for item in args.set:
         if "=" not in item:

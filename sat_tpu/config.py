@@ -112,6 +112,14 @@ class Config:
     # span ring-buffer capacity (percentile window; totals are exact
     # regardless — see sat_tpu/telemetry/spans.py)
     telemetry_buffer: int = 65536
+    # In-graph model-health taps (telemetry/device.py): scalar reductions
+    # (grad/update/param norms, masked attention entropy, the paper's
+    # alpha-coverage deviation, logit max) computed inside train_step and
+    # fetched at the existing log_every sync — no additional device syncs.
+    # "off" (default) leaves the compiled step bit-for-bit unchanged;
+    # "basic" adds global scalars; "full" adds per-layer-group norms that
+    # let the anomaly sentinel name which tensor went non-finite.
+    diag_level: str = "off"
 
     # ---- dataset-size caps (reference config.py:60-63) ----
     max_train_ann_num: Optional[int] = 1000
@@ -235,6 +243,7 @@ class Config:
             ("ce_dtype", ("float32", "bfloat16")),
             ("shard_cache", ("auto", "on", "off")),
             ("anomaly_policy", ("off", "warn", "skip", "rollback")),
+            ("diag_level", ("off", "basic", "full")),
         )
         for name, allowed in checks:
             if getattr(self, name) not in allowed:

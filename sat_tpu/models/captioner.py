@@ -232,4 +232,14 @@ def compute_loss(
         "attentions": attentions,
         "model_state": new_state,
     }
+    if train and config.diag_level != "off":
+        # forward-side diag taps (docs/OBSERVABILITY.md): computed here
+        # where alphas/logits are live so nothing bulky rides through aux;
+        # gated statically on diag_level, so the off-path XLA program is
+        # bit-for-bit the pre-diagnostics program
+        from ..telemetry.device import loss_taps
+
+        aux["metrics"].update(
+            loss_taps(config.diag_level, alphas=alphas, masks=masks, logits=logits)
+        )
     return total_loss, aux

@@ -74,10 +74,20 @@ class AnomalySentinel:
         return self.policy == "skip" and not self.healthy
 
     def _classify(self, metrics: Dict[str, float]) -> Optional[str]:
+        # name EVERY non-finite metric, not just the first: with
+        # --diag_level full the metrics dict carries per-layer-group
+        # norms (telemetry/device.py), so the finite/non-finite split of
+        # this list localizes WHICH tensor went bad
+        bad = []
         for name, value in metrics.items():
             v = float(value)
             if math.isnan(v) or math.isinf(v):
-                return f"{name}={v} is not finite"
+                bad.append(f"{name}={v}")
+        if bad:
+            shown = ", ".join(bad[:8])
+            if len(bad) > 8:
+                shown += f" (+{len(bad) - 8} more)"
+            return f"{shown} is not finite"
         loss = metrics.get("loss")
         if loss is not None and self.spike_factor > 0:
             v = float(loss)

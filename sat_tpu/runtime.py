@@ -171,12 +171,46 @@ class ProfilerWindow:
         self._last_sync = None
 
     def before_step(self, i: int) -> None:
-        """Call before dispatching step ``i``; opens the window once."""
+        """Call before dispatching step ``i``; opens the window once.
+
+        ``start_trace`` raises when another trace is already live in the
+        process — e.g. an outer ``jax.profiler`` session running
+        alongside ``--trace_export``'s host-side export, or a sweep whose
+        previous window leaked.  The window must not take the run down
+        for that: it marks itself fired FIRST (so a failed open is never
+        retried every subsequent step) and degrades the collision to a
+        warning, leaving ``_on`` false so ``after_step``/``__exit__``
+        never issue the double ``stop_trace`` that would close the OUTER
+        trace and leak this window's dir."""
         if self._dir and not self._fired and i >= self._start:
-            jax.profiler.start_trace(self._dir)
-            self._on = True
             self._fired = True
             self._stop_at = i + self._num
+            try:
+                jax.profiler.start_trace(self._dir)
+            except Exception as e:
+                print(
+                    f"sat_tpu: profiler window skipped — start_trace failed "
+                    f"(another trace active?): {e}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                return
+            self._on = True
+
+    def _stop(self) -> None:
+        """Close the trace this window opened; a stop failure (the trace
+        was stopped under us) degrades to a warning but still marks the
+        window closed so it is never double-stopped."""
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            print(
+                f"sat_tpu: profiler stop_trace failed ({e})",
+                file=sys.stderr,
+                flush=True,
+            )
+        self._on = False
+        self._last_sync = None
 
     def after_step(self, i: int, sync) -> None:
         """Call after dispatching step ``i``; closes the window when the
@@ -184,10 +218,8 @@ class ProfilerWindow:
         the trace contains completed device work)."""
         self._last_sync = sync  # __exit__'s sync target if the loop ends early
         if self._on and i + 1 >= self._stop_at:
-            jax.block_until_ready(sync)
-            jax.profiler.stop_trace()
-            self._on = False
-            self._last_sync = None
+            jax.block_until_ready(sync)  # sync-ok: trace-window close only
+            self._stop()
 
     def __enter__(self) -> "ProfilerWindow":
         return self
@@ -198,9 +230,11 @@ class ProfilerWindow:
         ``after_step`` sync target so the trace holds completed work."""
         if self._on:
             if self._last_sync is not None:
-                jax.block_until_ready(self._last_sync)
-            jax.profiler.stop_trace()
-            self._on = False
+                try:
+                    jax.block_until_ready(self._last_sync)  # sync-ok: window close
+                except Exception:
+                    pass  # sync target may be poisoned on the error path
+            self._stop()
         self._last_sync = None
 
 
@@ -271,10 +305,50 @@ def _telemetry_begin(config: Config):
     the null object when off) and the process-wide compile listener."""
     if config.telemetry:
         tel = telemetry.enable(config.telemetry_buffer)
+        from .telemetry import xla as xla_acct
+
+        xla_acct.reset()  # per-run compile accounting (compile_report.json)
     else:
         tel = telemetry.disable()
     _install_compile_listener()
     return tel
+
+
+def _device_static() -> dict:
+    """Heartbeat ``static`` device facts: backend plus the first local
+    device's kind/platform (degrades to just the backend when device
+    objects don't expose them)."""
+    static = {
+        "backend": jax.default_backend(),
+        "num_devices": jax.device_count(),
+    }
+    try:
+        d0 = jax.local_devices()[0]
+        static["device_kind"] = d0.device_kind
+        static["device_platform"] = d0.platform
+    except Exception:
+        pass
+    return static
+
+
+def _device_memory_sampler():
+    """Heartbeat sampler: per-device HBM bytes-in-use via the backend's
+    ``memory_stats()``.  CPU devices return None (or raise) — the sampler
+    then contributes nothing and the heartbeat degrades gracefully, per
+    docs/OBSERVABILITY.md."""
+
+    def sample() -> dict:
+        per: dict = {}
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats and "bytes_in_use" in stats:
+                per[str(d.id)] = int(stats["bytes_in_use"])
+        return {"hbm_bytes_in_use": per} if per else {}
+
+    return sample
 
 
 def _telemetry_finish(tel, config: Config, phase: str) -> None:
@@ -302,6 +376,21 @@ def _telemetry_finish(tel, config: Config, phase: str) -> None:
                 tdir,
                 "breakdown.json" if phase == "train" else f"breakdown-{phase}.json",
             ),
+        )
+    # compile-time cost/memory accounting (telemetry/xla.py): one report
+    # per phase, surfaced in the end-of-run printout next to the breakdown
+    from .telemetry import xla as xla_acct
+
+    summary = xla_acct.format_summary()
+    if summary is not None:
+        print(summary, flush=True)
+        xla_acct.write_report(
+            os.path.join(
+                tdir,
+                "compile_report.json"
+                if phase == "train"
+                else f"compile_report-{phase}.json",
+            )
         )
 
 
@@ -422,6 +511,7 @@ def train(
     # config.telemetry, the null object otherwise — the off path leaves
     # run behavior bit-for-bit unchanged
     tel = _telemetry_begin(config)
+    compile_probed = False  # train_step analyzed once, on the first batch
     import contextlib
 
     final_path: Optional[str] = None
@@ -443,11 +533,8 @@ def train(
                     os.path.join(_telemetry_dir(config), "heartbeat.json"),
                     config.heartbeat_interval,
                     tel,
-                    static={
-                        "phase": "train",
-                        "backend": jax.default_backend(),
-                        "num_devices": jax.device_count(),
-                    },
+                    static={"phase": "train", **_device_static()},
+                    sampler=_device_memory_sampler(),
                 )
                 _stack.callback(hb.stop)
                 hb.start()
@@ -501,18 +588,29 @@ def train(
                         stopped = True
                         break
                     prof.before_step(step)
-                    with tel.span("train/dispatch"):
-                        state, metrics = train_step(
-                            state,
-                            place_batch(
-                                {
-                                    "images": batch["images"],
-                                    "word_idxs": batch["word_idxs"],
-                                    "masks": batch["masks"],
-                                }
-                            ),
-                            jax.random.fold_in(root_rng, step),
+                    placed = place_batch(
+                        {
+                            "images": batch["images"],
+                            "word_idxs": batch["word_idxs"],
+                            "masks": batch["masks"],
+                        }
+                    )
+                    step_rng = jax.random.fold_in(root_rng, step)
+                    if tel.enabled and not compile_probed:
+                        # AOT cost/memory accounting BEFORE the first
+                        # dispatch: lowering reads only avals (donated
+                        # buffers stay intact) and seeds the same
+                        # lower/compile caches the call below hits, so
+                        # the step is not compiled twice
+                        compile_probed = True
+                        from .telemetry import xla as xla_acct
+
+                        xla_acct.analyze(
+                            "train_step", train_step, state, placed,
+                            step_rng, tel=tel,
                         )
+                    with tel.span("train/dispatch"):
+                        state, metrics = train_step(state, placed, step_rng)
                     prof.after_step(step, state)
                     step += 1  # == int(state.step), without a device sync
                     tel.gauge("train/step", step)
@@ -524,13 +622,21 @@ def train(
                         # these already-fetched floats, adding no syncs
                         with tel.span("train/log_sync"):
                             host = {
-                                k: float(v)
+                                k: float(v)  # sync-ok: the loop's ONE log-boundary fetch
                                 for k, v in jax.device_get(metrics).items()
                             }
                         writer.scalars(step, host)
                         if tel.enabled:
                             from .telemetry import exporters
 
+                            # diag taps (telemetry/device.py) ride the
+                            # host dict just fetched: gauging them here
+                            # lands the last-known snapshot in
+                            # telemetry.jsonl and heartbeat.json without
+                            # touching the device again
+                            for k, v in host.items():
+                                if k.startswith("diag/"):
+                                    tel.gauge(k, v)
                             exporters.append_jsonl(
                                 tel,
                                 os.path.join(
@@ -637,14 +743,14 @@ def _restore_last_good(
         return None
     print(
         f"sat_tpu: rolled back to {path} "
-        f"(step {int(np.asarray(restored.step))}); resuming forward at "
+        f"(step {int(np.asarray(restored.step))}); resuming forward at "  # sync-ok: rollback epilogue, off the hot path
         f"step {step}, skipping the poison window",
         file=sys.stderr,
         flush=True,
     )
     # device-owned copy, not a numpy scalar: the step leaf is donated into
     # train_step along with the rest of the state (see _assign_leaves)
-    return restored._replace(step=jax.numpy.array(np.asarray(step, np.int32)))
+    return restored._replace(step=jax.numpy.array(np.asarray(step, np.int32)))  # sync-ok: host int, not a device value
 
 
 # ---------------------------------------------------------------------------
@@ -770,7 +876,7 @@ def decode_dataset(
                     )
                     gathered.append(
                         tuple(
-                            np.asarray(x) for x in gather_tree_replicated(best)
+                            np.asarray(x) for x in gather_tree_replicated(best)  # sync-ok: decode drain boundary (gathered beam-0)
                         )
                     )
             return _assemble_mesh_results(dataset, vocabulary, gathered)
@@ -782,13 +888,32 @@ def decode_dataset(
             contexts, _ = encode(variables, config, images, train=False)
             return contexts
 
+        decode_probed = []  # compile accounting fires once, on batch 0
+
         def run_batch(batch):
             contexts = encode_fn(variables, batch["images"])
-            return beam_search_jit(
-                state.params["decoder"], config, contexts, eos,
+            beam_kwargs = dict(
                 beam_size=config.beam_size,
                 valid_size=len(vocabulary.words),
                 return_alphas=config.save_attention_maps,
+            )
+            if not decode_probed:
+                decode_probed.append(True)
+                tel_now = telemetry.get()
+                if tel_now.enabled:
+                    from .telemetry import xla as xla_acct
+
+                    xla_acct.analyze(
+                        "decode/encode", encode_fn, variables,
+                        batch["images"], tel=tel_now,
+                    )
+                    xla_acct.analyze(
+                        "decode/beam_search", beam_search_jit,
+                        state.params["decoder"], config, contexts, eos,
+                        tel=tel_now, **beam_kwargs,
+                    )
+            return beam_search_jit(
+                state.params["decoder"], config, contexts, eos, **beam_kwargs
             )
 
     loader = make_loader(config, dataset)
@@ -803,11 +928,11 @@ def decode_dataset(
 
     def drain(out, files):
         nonlocal emitted
-        words = np.asarray(out.words[:, 0])        # best caption per image
-        lengths = np.asarray(out.lengths[:, 0])
-        scores = np.asarray(out.log_scores[:, 0])
+        words = np.asarray(out.words[:, 0])        # best caption per image  # sync-ok: decode drain boundary
+        lengths = np.asarray(out.lengths[:, 0])  # sync-ok: decode drain boundary
+        scores = np.asarray(out.log_scores[:, 0])  # sync-ok: decode drain boundary
         alphas = (
-            np.asarray(out.alphas[:, 0]) if out.alphas is not None else None
+            np.asarray(out.alphas[:, 0]) if out.alphas is not None else None  # sync-ok: decode drain boundary
         )
         for i, image_file in enumerate(files):
             if emitted >= dataset.count:           # fake_count padding
@@ -826,7 +951,7 @@ def decode_dataset(
                 "image_id": image_id,
                 "image_file": str(image_file),
                 "caption": caption,
-                "prob": float(np.exp(scores[i])),
+                "prob": float(np.exp(scores[i])),  # sync-ok: host numpy, already drained
             }
             if alphas is not None:
                 row["words"] = [
@@ -923,7 +1048,7 @@ def _assemble_mesh_results(
             "image_id": image_id,
             "image_file": str(dataset.image_files[g]),
             "caption": vocabulary.get_sentence(word_row[:length]),
-            "prob": float(np.exp(score)),
+            "prob": float(np.exp(score)),  # sync-ok: host numpy, already drained
         }
         if rest:                                 # gathered beam-0 alphas
             row["words"] = [vocabulary.words[w] for w in word_row[:length]]
@@ -960,7 +1085,7 @@ def _render_attention_panel(
     # one shared color scale across the caption: per-tile autoscaling
     # would stretch a near-uniform map to the same contrast as a sharply
     # peaked one, faking localization
-    vmax = float(alphas.max()) or 1.0
+    vmax = float(alphas.max()) or 1.0  # sync-ok: host numpy, render path
 
     label_h = 22
     pad = 6
@@ -1033,6 +1158,33 @@ def _save_attention_panels(results: List[Dict[str, Any]], out_dir: str) -> None:
                 raise  # single-process: a missing image is a real error
             # multi-host without shared image storage: this host only has
             # its own data shard's images; another host renders the rest
+
+
+def _export_attention_artifacts(
+    results: List[Dict[str, Any]], out_dir: str
+) -> None:
+    """Machine-readable attention introspection next to the JPG panels:
+    attn.jsonl (per-caption alpha grids + entropy/coverage stats) and the
+    self-contained HTML contact sheet (telemetry/exporters.py).  Process
+    0 only — every host holds the full result list after a mesh decode,
+    and these artifacts are whole-run files, not per-image renders."""
+    if jax.process_index() != 0:
+        return
+    from .telemetry import exporters as tel_exporters
+
+    os.makedirs(out_dir, exist_ok=True)
+    n = tel_exporters.export_attention_jsonl(
+        results, os.path.join(out_dir, "attn.jsonl")
+    )
+    sheet = tel_exporters.render_attention_sheet(
+        results, os.path.join(out_dir, "attn.html")
+    )
+    if n:
+        print(
+            f"attention introspection: {n} captions -> "
+            f"{os.path.join(out_dir, 'attn.jsonl')}"
+            + (f", contact sheet {sheet}" if sheet else "")
+        )
 
 
 def _render_caption_images(results: List[Dict[str, Any]], out_dir: str) -> None:
@@ -1128,6 +1280,7 @@ def evaluate(
         _render_caption_images(results, config.eval_result_dir)
     if config.save_attention_maps:
         _save_attention_panels(results, config.eval_result_dir)
+        _export_attention_artifacts(results, config.eval_result_dir)
 
     coco_res = coco.load_results(payload)
     scorer = CocoEvalCap(coco, coco_res, eval_data=dataset)
@@ -1191,6 +1344,7 @@ def test(
     _render_caption_images(results, config.test_result_dir)
     if config.save_attention_maps:
         _save_attention_panels(results, config.test_result_dir)
+        _export_attention_artifacts(results, config.test_result_dir)
 
     import pandas as pd
 

@@ -37,6 +37,65 @@ from .spans import (  # noqa: F401
 # joins never depend on file mtimes or directory layout.
 RUN_ID = f"{int(time.time()):x}-{os.getpid()}"
 
+# Version of the benchmark/report artifact contract (BENCH JSON rows,
+# compile_report.json).  scripts/check_regression.py refuses to compare
+# artifacts stamped with a different major version; bump it when a field
+# changes meaning (not when fields are added).
+SCHEMA_VERSION = 1
+
 
 def run_id() -> str:
     return RUN_ID
+
+
+def bench_stamp() -> dict:
+    """Provenance stamp shared by every ``scripts/bench_*.py`` JSON output
+    and ``compile_report.json``: artifact schema version, git SHA, and a
+    device/host descriptor — the fields ``check_regression.py`` needs to
+    decide whether two artifacts are comparable at all.
+
+    Deliberately import-light: no jax import ever (this package is
+    jax-free); device facts are read only when the caller already
+    initialized jax, and only via ``sys.modules`` so a host-only bench
+    (bench_telemetry, bench_input) never drags a backend in.  Callers
+    stamp at emit time — after their device work — so touching
+    ``local_devices()`` here never triggers a fresh backend init."""
+    import platform
+    import subprocess
+    import sys
+
+    sha = None
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+        sha = out.stdout.strip() or None
+    except Exception:
+        pass
+    device = {
+        "host": platform.node(),
+        "machine": platform.machine(),
+        "python": platform.python_version(),
+    }
+    if "jax" in sys.modules:
+        try:
+            jax = sys.modules["jax"]
+            d0 = jax.local_devices()[0]
+            device.update(
+                platform=d0.platform,
+                kind=d0.device_kind,
+                device_count=jax.device_count(),
+            )
+        except Exception:
+            pass
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "git_sha": sha,
+        "run_id": RUN_ID,
+        "stamp_unix": round(time.time(), 3),
+        "device": device,
+    }

@@ -1,0 +1,176 @@
+"""In-graph model-health taps (``--diag_level``, docs/OBSERVABILITY.md).
+
+The host-side telemetry layer (spans/heartbeat) times the run but the
+device stays a black box: when loss drifts nobody can say whether the
+gradient exploded, which layer group it exploded in, or whether the
+doubly-stochastic attention regularizer is actually flattening the
+alphas the paper is built around.  These taps answer that *from inside
+the compiled step*: a small dict of scalar reductions computed next to
+the gradients and merged into the metrics pytree ``train_step`` already
+returns, so they ride the existing ``log_every`` ``device_get`` boundary
+in ``runtime.train`` — **zero additional device syncs**, just a few more
+scalars on the one fetch the loop already pays for.
+
+Unlike the rest of ``sat_tpu.telemetry`` this module imports jax (the
+taps are traced code); it is therefore NOT imported by the package
+``__init__`` — ``train/step.py`` and ``models/captioner.py`` import it
+directly, and only when ``config.diag_level != "off"``, so the off
+path's XLA program is bit-for-bit the pre-diagnostics program
+(tests/test_device_diag.py pins this).
+
+Tap catalogue (all fp32 scalars, keys prefixed ``diag/``):
+
+==================================  =====  ==================================
+key                                 level  meaning
+==================================  =====  ==================================
+``diag/param_norm``                 basic  global L2 of the trainable tree
+``diag/update_norm``                basic  global L2 of the optimizer update
+``diag/update_ratio``               basic  update_norm / param_norm — the
+                                           classic LR-sanity signal (~1e-3)
+``diag/attn_entropy``               basic  mean masked per-word attention
+                                           entropy H_t = -Σ_i α_ti ln α_ti
+``diag/attn_entropy_frac``          basic  attn_entropy / ln N (1 = uniform,
+                                           0 = one-hot)
+``diag/alpha_coverage_dev``         basic  mean_{b,i} (1 - Σ_t α_ti)² — the
+                                           paper's doubly-stochastic term,
+                                           unscaled (= 2·attention_loss /
+                                           attention_loss_factor)
+``diag/logit_max``                  basic  max |pre-softmax logit| — drift
+                                           here precedes softmax saturation
+``diag/grad_nonfinite``             full   count of non-finite grad leaves'
+                                           elements
+``diag/grad_norm/<group>``          full   per-layer-group grad L2
+``diag/update_norm/<group>``        full   per-layer-group update L2
+``diag/param_norm/<group>``         full   per-layer-group param L2
+==================================  =====  ==================================
+
+Per-group keys localize a blow-up: a NaN in ``lstm/kernel`` makes
+``diag/grad_norm/decoder.lstm`` (and everything downstream) NaN while
+``.../decoder.word_embedding`` stays finite, and the anomaly sentinel
+(resilience/sentinel.py) names every non-finite metric key in its
+report — the taps are how it learns *which* tensor went bad.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+DIAG_LEVELS = ("off", "basic", "full")
+
+
+def _l2(tree) -> jnp.ndarray:
+    """Global L2 norm of a pytree, accumulated in fp32 (optax.global_norm
+    without the optax import — this module must stay importable from
+    model code)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    )
+
+
+def _nonfinite_count(tree) -> jnp.ndarray:
+    """Total count of non-finite elements across the pytree's leaves."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0)
+    return sum(
+        jnp.sum(~jnp.isfinite(x.astype(jnp.float32))) for x in leaves
+    ).astype(jnp.float32)
+
+
+def _layer_groups(tree: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten a params-like dict one level: {"decoder": {"lstm": ...}} →
+    {"decoder.lstm": ...} — the per-layer-group granularity of the full
+    taps.  Non-dict values keep their top-level name."""
+    groups: Dict[str, Any] = {}
+    for top, sub in tree.items():
+        if isinstance(sub, dict) and sub:
+            for name, leaf_tree in sub.items():
+                groups[f"{top}.{name}"] = leaf_tree
+        else:
+            groups[top] = sub
+    return groups
+
+
+def attention_entropy(
+    alphas: jnp.ndarray, masks: jnp.ndarray
+) -> jnp.ndarray:
+    """Mean per-word attention entropy over real (masked-in) words.
+
+    alphas: [B,T,N] softmax rows; masks: [B,T].  H_t = -Σ_i α_ti ln α_ti,
+    averaged over the mask.  ln N for a uniform map (≈5.28 for N=196),
+    0 for a one-hot map."""
+    a = alphas.astype(jnp.float32)
+    h = -jnp.sum(a * jnp.log(jnp.clip(a, 1e-10, 1.0)), axis=-1)  # [B,T]
+    m = masks.astype(jnp.float32)
+    return jnp.sum(h * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def alpha_coverage_deviation(
+    alphas: jnp.ndarray, masks: jnp.ndarray
+) -> jnp.ndarray:
+    """mean_{b,i} (1 - Σ_t α_ti)² over masked alphas — the unscaled
+    doubly-stochastic attention penalty (Xu et al. eq. 14; captioner
+    scales it by ``attention_loss_factor * 0.5``)."""
+    a = alphas.astype(jnp.float32) * masks.astype(jnp.float32)[..., None]
+    coverage = a.sum(axis=1)                       # [B,N]
+    d = 1.0 - coverage
+    return jnp.mean(d * d)
+
+
+def loss_taps(
+    level: str,
+    *,
+    alphas: jnp.ndarray,
+    masks: jnp.ndarray,
+    logits: jnp.ndarray,
+) -> Dict[str, jnp.ndarray]:
+    """Forward-pass taps, computed where the loss already holds the
+    alphas/logits (models/captioner.compute_loss) so nothing extra rides
+    through aux."""
+    if level == "off":
+        return {}
+    ent = attention_entropy(alphas, masks)
+    n = alphas.shape[-1]
+    return {
+        "diag/attn_entropy": ent,
+        "diag/attn_entropy_frac": ent / jnp.float32(jnp.log(float(n))),
+        "diag/alpha_coverage_dev": alpha_coverage_deviation(alphas, masks),
+        "diag/logit_max": jnp.max(jnp.abs(logits.astype(jnp.float32))),
+    }
+
+
+def grad_taps(
+    level: str,
+    *,
+    grads: Dict[str, Any],
+    updates: Dict[str, Any],
+    params: Dict[str, Any],
+) -> Dict[str, jnp.ndarray]:
+    """Backward/update-side taps, computed in train_step where the
+    gradient and optimizer update trees are live.  ``params`` is the
+    post-update trainable tree."""
+    if level == "off":
+        return {}
+    param_norm = _l2(params)
+    update_norm = _l2(updates)
+    taps: Dict[str, jnp.ndarray] = {
+        "diag/param_norm": param_norm,
+        "diag/update_norm": update_norm,
+        "diag/update_ratio": update_norm / jnp.maximum(param_norm, 1e-12),
+    }
+    if level == "full":
+        taps["diag/grad_nonfinite"] = _nonfinite_count(grads)
+        for kind, tree in (
+            ("grad_norm", grads),
+            ("update_norm", updates),
+            ("param_norm", params),
+        ):
+            for group, sub in _layer_groups(tree).items():
+                taps[f"diag/{kind}/{group}"] = _l2(sub)
+    return taps

@@ -63,11 +63,17 @@ class Heartbeat:
         interval_s: float,
         tel,
         static: Optional[Dict] = None,
+        sampler=None,
     ) -> None:
         self.path = path
         self.interval_s = max(0.05, float(interval_s))
         self._tel = tel
         self._static = dict(static or {})
+        # optional zero-arg callable merged into each beat (runtime passes
+        # a device-memory probe built around jax's memory_stats()); the
+        # heartbeat itself stays jax-free and a sampler failure or an
+        # empty return (CPU backends expose no stats) degrades to absence
+        self._sampler = sampler
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._seq = 0
@@ -107,6 +113,20 @@ class Heartbeat:
             "rss_mb": round(_rss_bytes() / (1 << 20), 1),
             "counters": counters,
         }
+        # last diag-tap / compile-accounting snapshot: the instrumented
+        # loops gauge these at the log boundary, so one heartbeat file
+        # answers "is the gradient sane and what does the step cost"
+        diag = {k[len("diag/"):]: v for k, v in gauges.items() if k.startswith("diag/")}
+        if diag:
+            payload["diag"] = diag
+        xla = {k[len("xla/"):]: v for k, v in gauges.items() if k.startswith("xla/")}
+        if xla:
+            payload["xla"] = xla
+        if self._sampler is not None:
+            try:
+                payload.update(self._sampler() or {})
+            except Exception:
+                pass  # device stats are best-effort, never fatal
         payload.update(self._static)
         return payload
 

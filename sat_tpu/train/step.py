@@ -89,6 +89,21 @@ def make_train_step(config: Config):
         metrics["attention/mean"] = jnp.mean(att)
         metrics["attention/std"] = jnp.std(att)
         metrics["attention/max"] = jnp.max(att)
+        if config.diag_level != "off":
+            # update-side diag taps (telemetry/device.py): merged into the
+            # metrics pytree so they ride the existing log-sync fetch —
+            # zero extra device syncs.  Statically gated: with diag off
+            # this branch never traces and the step program is unchanged.
+            from ..telemetry.device import grad_taps
+
+            metrics.update(
+                grad_taps(
+                    config.diag_level,
+                    grads=grads,
+                    updates=updates,
+                    params=new_trainable,
+                )
+            )
         return new_state, metrics
 
     return train_step

@@ -155,6 +155,9 @@ def main() -> int:
                 round(lineage_amortized_pct, 4),
             "last_good_walk_ms": round(walk_ms, 2),
         }
+        from sat_tpu.telemetry import bench_stamp
+
+        result.update(bench_stamp())
         print(json.dumps(result), flush=True)
         return 0 if overhead_pct < 2.0 else 1
     finally:

@@ -18,6 +18,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+
+def _bench_stamp() -> dict:
+    # imported lazily: the stamp reads jax device facts only when the
+    # bench already initialized a backend (sat_tpu.telemetry.bench_stamp)
+    from sat_tpu.telemetry import bench_stamp
+
+    return bench_stamp()
+
 import numpy as np
 
 
@@ -146,6 +154,7 @@ def main() -> int:
                 "batch_ms": round(windows_ms[0], 1),
                 "early_exit": not args.no_early_exit,
                 "device_kind": getattr(dev, "device_kind", dev.platform),
+                **_bench_stamp(),
             }
         ),
         flush=True,

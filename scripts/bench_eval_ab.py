@@ -128,6 +128,9 @@ def run_arm(args) -> int:
         "compile_s": round(compile_s, 1),
         "device_kind": getattr(dev, "device_kind", dev.platform),
     }
+    from sat_tpu.telemetry import bench_stamp
+
+    row.update(bench_stamp())
     del resident_state
     print(json.dumps(row), flush=True)
     return 0
@@ -206,6 +209,9 @@ def main() -> int:
         "resident_over_fresh": round(resident / fresh, 4),
         "rows": rows,
     }
+    from sat_tpu.telemetry import bench_stamp
+
+    summary.update(bench_stamp())
     line = json.dumps(summary)
     print(line, flush=True)
     if args.out:

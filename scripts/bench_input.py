@@ -169,6 +169,9 @@ def main() -> int:
             "build_s": round(build_s, 2),
             "window": "sync",
         }
+        from sat_tpu.telemetry import bench_stamp
+
+        result.update(bench_stamp())
         print(json.dumps(result), flush=True)  # first contract line, early
 
         # --- overlap window: exposed host wait behind a simulated step --

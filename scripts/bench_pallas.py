@@ -174,7 +174,14 @@ def main() -> int:
         rows.append(row)
 
     min_speedup = min(r["speedup"] for r in rows)
-    print(json.dumps({"sweep": rows, "min_speedup": min_speedup}), flush=True)
+    from sat_tpu.telemetry import bench_stamp
+
+    print(
+        json.dumps(
+            {"sweep": rows, "min_speedup": min_speedup, **bench_stamp()}
+        ),
+        flush=True,
+    )
     if args.cpu:
         # interpret-mode timings are meaningless; the smoke's value is
         # that the sweep + correctness plumbing ran — no verdict off-TPU

@@ -127,6 +127,7 @@ def main() -> int:
             "log_every": args.log_every,
             "ring_capacity": tel._capacity,
             "export_ms": round(export_ms, 1),
+            **telemetry.bench_stamp(),
         }
         print(json.dumps(result), flush=True)
         return 0 if overhead_pct <= 0.5 else 1

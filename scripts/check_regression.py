@@ -1,0 +1,406 @@
+"""Automated perf/quality regression gate over BENCH + compile_report artifacts.
+
+The repo accumulates a measurement trajectory — ``BENCH_r0*.json`` driver
+wrappers, ``runs/*/bench_*.json`` BENCH-contract rows, ``BASELINE.json``
+published numbers, and (since the telemetry PRs) ``compile_report.json``
+FLOP/HBM accounting.  Until now a PR that regressed any of it relied on a
+human noticing.  This script is the contract: feed it the prior artifacts
+and a fresh one, and it exits nonzero when the fresh numbers are worse
+than the best prior beyond a per-metric noise margin.
+
+Usage
+-----
+Trajectory mode (chronological; the LAST file is the candidate)::
+
+    python scripts/check_regression.py BENCH_r01.json BENCH_r02.json fresh.json
+
+Explicit pair mode::
+
+    python scripts/check_regression.py --baseline prior.json --current fresh.json
+
+Compile-report mode (may be combined with either of the above)::
+
+    python scripts/check_regression.py \
+        --compile-baseline runs/prior/compile_report.json \
+        --compile-current  out/telemetry/compile_report.json
+
+Inputs accepted per file: a BENCH-contract JSONL stream
+(``{"metric","value","unit","vs_baseline",...}`` per line), one JSON
+object/array of such rows, or a bench-driver wrapper
+(``{"n","cmd","rc","tail","parsed"}`` — only ``parsed`` is read).
+Wrappers whose run never produced numbers (``parsed: null``, the
+device-unreachable sessions) contribute nothing; when NO comparable pair
+exists the gate exits 0 with a warning — an unreachable device must not
+fail CI, only a measured regression may.
+
+Schema compatibility: rows/reports stamped with a ``schema_version``
+different from the current ``sat_tpu.telemetry.SCHEMA_VERSION`` are
+REFUSED (exit 3) — a changed contract must bump the version and reset
+the trajectory.  Unstamped rows are legacy and compared best-effort.
+
+Direction + margins: each metric has a better-direction (throughput up,
+time/FLOPs/bytes down — see ``_lower_better``) and a noise margin in
+percent (defaults below, override with ``--margin name=pct``).  The
+candidate is compared against the BEST prior value so a noisy low prior
+can't mask a real regression.
+
+Exit codes: 0 = no regression (or nothing comparable), 2 = regression,
+3 = incompatible schema, 1 = usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sat_tpu.telemetry import SCHEMA_VERSION
+
+
+class SchemaMismatch(Exception):
+    pass
+
+
+# per-metric noise margins in percent of the best prior value
+DEFAULT_MARGINS = {
+    "flops": 1.0,              # compile-time FLOPs are exact; 1% = real change
+    "temp_bytes": 2.0,         # HBM temp footprint: layout jitter only
+    "output_bytes": 2.0,
+    "argument_bytes": 2.0,
+    "step_time_ms": 5.0,       # wall-clock: CI noise
+    "train_captions_per_sec": 5.0,
+    "eval_images_per_sec": 5.0,
+    "Bleu_4": 1.0,             # quality: a point of BLEU is never noise
+    "CIDEr": 1.0,
+}
+FALLBACK_MARGIN = 5.0
+
+# metrics where SMALLER is better; everything else is throughput/quality
+_LOWER_BETTER_EXACT = {
+    "step_time_ms",
+    "compile_s",
+    "telemetry_hot_path_overhead",
+    "diag_tap_overhead",
+    "ckpt_step_overhead",
+    "flops",
+    "transcendentals",
+    "bytes_accessed",
+    "temp_bytes",
+    "output_bytes",
+    "argument_bytes",
+}
+# explicitly HIGHER-better (checked first — "per_sec" would otherwise
+# trip the "_s" suffix heuristic below)
+_HIGHER_BETTER_EXACT = {
+    "train_captions_per_sec",
+    "eval_images_per_sec",
+    "shard_feed_speedup",
+    "min_speedup",
+    "Bleu_4",
+    "CIDEr",
+    "METEOR",
+    "ROUGE_L",
+}
+_LOWER_BETTER_TOKENS = ("overhead", "seconds", "bytes", "latency")
+_LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_us", "_mb", "_time")
+
+
+def _lower_better(metric: str) -> bool:
+    if metric in _HIGHER_BETTER_EXACT:
+        return False
+    if metric in _LOWER_BETTER_EXACT:
+        return True
+    m = metric.lower()
+    if "per_sec" in m or "speedup" in m or "throughput" in m:
+        return False
+    return any(tok in m for tok in _LOWER_BETTER_TOKENS) or m.endswith(
+        _LOWER_BETTER_SUFFIXES
+    )
+
+
+def _check_schema(obj: Dict, path: str) -> None:
+    v = obj.get("schema_version")
+    if v is not None and v != SCHEMA_VERSION:
+        raise SchemaMismatch(
+            f"{path}: schema_version={v} is incompatible with this repo's "
+            f"SCHEMA_VERSION={SCHEMA_VERSION} — refusing to compare"
+        )
+
+
+def _rows_from_obj(obj: Any, path: str) -> List[Dict]:
+    """Normalize one parsed JSON value into BENCH rows."""
+    if obj is None:
+        return []
+    if isinstance(obj, list):
+        rows: List[Dict] = []
+        for item in obj:
+            rows.extend(_rows_from_obj(item, path))
+        return rows
+    if not isinstance(obj, dict):
+        return []
+    if "parsed" in obj and "rc" in obj:      # bench-driver wrapper
+        return _rows_from_obj(obj.get("parsed"), path)
+    if "metric" in obj:
+        _check_schema(obj, path)
+        value = obj.get("value")
+        if isinstance(value, (int, float)):
+            return [obj]
+        return []                            # degraded row (value null)
+    return []
+
+
+def load_rows(path: str) -> List[Dict]:
+    """BENCH rows from one artifact file (JSON, JSON array, JSONL, or
+    driver wrapper).  IO/parse failures raise — a missing candidate file
+    is a usage error, not a pass."""
+    with open(path) as f:
+        text = f.read().strip()
+    if not text:
+        return []
+    try:
+        return _rows_from_obj(json.loads(text), path)
+    except json.JSONDecodeError:
+        rows: List[Dict] = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            rows.extend(_rows_from_obj(json.loads(line), path))
+        return rows
+
+
+def best_prior(
+    values: List[float], lower_better: bool
+) -> float:
+    return min(values) if lower_better else max(values)
+
+
+def compare_metric(
+    metric: str,
+    prior: List[float],
+    current: float,
+    margins: Dict[str, float],
+) -> Tuple[bool, str]:
+    """(is_regression, human line) for one metric."""
+    lower = _lower_better(metric)
+    best = best_prior(prior, lower)
+    margin = margins.get(metric, FALLBACK_MARGIN)
+    if best == 0:
+        delta_pct = 0.0 if current == 0 else float("inf")
+    else:
+        delta_pct = 100.0 * (current - best) / abs(best)
+    worse = delta_pct > margin if lower else delta_pct < -margin
+    arrow = "↓ better" if lower else "↑ better"
+    verdict = "REGRESSION" if worse else "ok"
+    return worse, (
+        f"{metric:<32} best-prior {best:g}  current {current:g}  "
+        f"delta {delta_pct:+.2f}% (margin {margin:g}%, {arrow}): {verdict}"
+    )
+
+
+def check_bench(
+    prior_files: List[str],
+    current_file: str,
+    margins: Dict[str, float],
+) -> Tuple[int, List[str]]:
+    """Compare the candidate file's rows against every prior file.
+    Returns (regression_count, report_lines); raises SchemaMismatch."""
+    prior_by_metric: Dict[str, List[float]] = {}
+    prior_step_ms: Dict[str, List[float]] = {}
+    for path in prior_files:
+        for row in load_rows(path):
+            prior_by_metric.setdefault(row["metric"], []).append(
+                float(row["value"])
+            )
+            if isinstance(row.get("step_time_ms"), (int, float)):
+                prior_step_ms.setdefault(row["metric"], []).append(
+                    float(row["step_time_ms"])
+                )
+
+    current_rows = load_rows(current_file)
+    lines: List[str] = []
+    regressions = 0
+    compared = 0
+    for row in current_rows:
+        metric = row["metric"]
+        if metric in prior_by_metric:
+            compared += 1
+            worse, line = compare_metric(
+                metric, prior_by_metric[metric], float(row["value"]), margins
+            )
+            regressions += worse
+            lines.append(line)
+        # step_time_ms rides many throughput rows as an extra field and
+        # regresses independently of the headline metric
+        if metric in prior_step_ms and isinstance(
+            row.get("step_time_ms"), (int, float)
+        ):
+            compared += 1
+            worse, line = compare_metric(
+                "step_time_ms",
+                prior_step_ms[metric],
+                float(row["step_time_ms"]),
+                margins,
+            )
+            regressions += worse
+            lines.append(f"[{metric}] {line}")
+    if not compared:
+        lines.append(
+            "warning: no comparable metric rows between candidate and "
+            "priors (unparsed/degraded artifacts?) — nothing to gate"
+        )
+    return regressions, lines
+
+
+def check_compile_reports(
+    baseline_path: str, current_path: str, margins: Dict[str, float]
+) -> Tuple[int, List[str]]:
+    """Gate per-function FLOPs and HBM footprints between two
+    compile_report.json files; compile time is reported, never gated
+    (cache hits make it meaningless across runs)."""
+    with open(baseline_path) as f:
+        base = json.load(f)
+    with open(current_path) as f:
+        cur = json.load(f)
+    _check_schema(base, baseline_path)
+    _check_schema(cur, current_path)
+    lines: List[str] = []
+    regressions = 0
+    compared = 0
+    for name, cur_fn in (cur.get("functions") or {}).items():
+        base_fn = (base.get("functions") or {}).get(name)
+        if not base_fn:
+            continue
+        pairs: List[Tuple[str, Optional[float], Optional[float]]] = [
+            (
+                "flops",
+                (base_fn.get("cost") or {}).get("flops"),
+                (cur_fn.get("cost") or {}).get("flops"),
+            )
+        ]
+        for key in ("temp_bytes", "output_bytes", "argument_bytes"):
+            pairs.append(
+                (
+                    key,
+                    (base_fn.get("memory") or {}).get(key),
+                    (cur_fn.get("memory") or {}).get(key),
+                )
+            )
+        for key, b, c in pairs:
+            if b is None or c is None:
+                continue
+            compared += 1
+            worse, line = compare_metric(key, [float(b)], float(c), margins)
+            regressions += worse
+            lines.append(f"[{name}] {line}")
+        b_s, c_s = base_fn.get("compile_seconds"), cur_fn.get("compile_seconds")
+        if b_s is not None and c_s is not None:
+            lines.append(
+                f"[{name}] compile_seconds {b_s:g} -> {c_s:g} (informational)"
+            )
+    if not compared:
+        lines.append(
+            "warning: compile reports share no comparable functions/fields"
+        )
+    return regressions, lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="BENCH/compile_report regression gate "
+        "(exit 0 ok, 2 regression, 3 schema mismatch)"
+    )
+    ap.add_argument(
+        "trajectory",
+        nargs="*",
+        help="bench artifacts in chronological order; the LAST is the candidate",
+    )
+    ap.add_argument("--baseline", help="explicit prior bench artifact")
+    ap.add_argument("--current", help="explicit candidate bench artifact")
+    ap.add_argument("--compile-baseline", help="prior compile_report.json")
+    ap.add_argument("--compile-current", help="candidate compile_report.json")
+    ap.add_argument(
+        "--margin",
+        action="append",
+        default=[],
+        metavar="METRIC=PCT",
+        help="override a per-metric noise margin (repeatable)",
+    )
+    args = ap.parse_args(argv)
+
+    margins = dict(DEFAULT_MARGINS)
+    for spec in args.margin:
+        name, _, pct = spec.partition("=")
+        try:
+            margins[name] = float(pct)
+        except ValueError:
+            ap.error(f"--margin {spec!r}: expected METRIC=PCT")
+
+    # shells without glob expansion (CI yaml) pass the pattern literally
+    files: List[str] = []
+    for pattern in args.trajectory:
+        matched = sorted(_glob.glob(pattern)) if any(
+            ch in pattern for ch in "*?["
+        ) else [pattern]
+        files.extend(matched)
+
+    jobs = 0
+    regressions = 0
+    try:
+        if args.baseline or args.current:
+            if not (args.baseline and args.current):
+                ap.error("--baseline and --current must be given together")
+            jobs += 1
+            n, lines = check_bench([args.baseline], args.current, margins)
+            regressions += n
+            print("\n".join(lines))
+        if len(files) >= 2:
+            jobs += 1
+            n, lines = check_bench(files[:-1], files[-1], margins)
+            regressions += n
+            print("\n".join(lines))
+        elif files:
+            # a single artifact has nothing to regress against: validate
+            # it (schema + parse) and pass
+            jobs += 1
+            rows = load_rows(files[0])
+            print(
+                f"{files[0]}: {len(rows)} row(s), no prior artifacts — "
+                "nothing to gate"
+            )
+        if args.compile_baseline or args.compile_current:
+            if not (args.compile_baseline and args.compile_current):
+                ap.error(
+                    "--compile-baseline and --compile-current must be "
+                    "given together"
+                )
+            jobs += 1
+            n, lines = check_compile_reports(
+                args.compile_baseline, args.compile_current, margins
+            )
+            regressions += n
+            print("\n".join(lines))
+    except SchemaMismatch as e:
+        print(f"check_regression: {e}", file=sys.stderr)
+        return 3
+    except (OSError, json.JSONDecodeError, KeyError, ValueError) as e:
+        print(f"check_regression: bad artifact: {e}", file=sys.stderr)
+        return 1
+
+    if jobs == 0:
+        ap.error("nothing to do: pass a trajectory, --baseline/--current, "
+                 "or --compile-baseline/--compile-current")
+    if regressions:
+        print(f"check_regression: {regressions} regression(s)", file=sys.stderr)
+        return 2
+    print("check_regression: no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

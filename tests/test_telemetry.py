@@ -406,6 +406,60 @@ def test_profiler_window_exit_idempotent(fake_profiler):
     assert [c[0] for c in fake_profiler].count("stop") == 1
 
 
+def test_profiler_window_start_collision_degrades_and_never_stops(
+    fake_profiler, monkeypatch, capsys
+):
+    """--profile_dir alongside an already-live trace (e.g. an outer
+    jax.profiler session next to --trace_export): start_trace raises.
+    The window must (a) not take the run down, (b) not retry the open on
+    every later step, and (c) never issue the stop_trace that would
+    close the OUTER trace."""
+    import jax
+
+    from sat_tpu.runtime import ProfilerWindow
+
+    calls = []
+
+    def boom(d):
+        calls.append(("start", d))
+        raise RuntimeError("Only one profile may be run at a time.")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    with ProfilerWindow(_window_config(profile_start_step=0)) as prof:
+        for i in range(10):
+            prof.before_step(i)
+            prof.after_step(i, i)
+    assert calls == [("start", "/tmp/prof")]       # opened once, not per step
+    assert ("stop",) not in fake_profiler          # outer trace left alone
+    assert ("sync", 0) not in fake_profiler        # no close sync either
+    assert "start_trace failed" in capsys.readouterr().err
+
+
+def test_profiler_window_stop_failure_degrades_and_stays_closed(
+    fake_profiler, monkeypatch, capsys
+):
+    """stop_trace raising (the trace was stopped under us) must not
+    propagate into the train loop, and __exit__ must not try a second
+    stop afterwards."""
+    import jax
+
+    from sat_tpu.runtime import ProfilerWindow
+
+    stops = []
+
+    def boom():
+        stops.append("stop")
+        raise RuntimeError("No profile started")
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", boom)
+    with ProfilerWindow(_window_config(profile_start_step=0)) as prof:
+        for i in range(5):
+            prof.before_step(i)
+            prof.after_step(i, i)   # window closes (and fails) at step 2
+    assert stops == ["stop"]        # __exit__ saw a closed window: no retry
+    assert "stop_trace failed" in capsys.readouterr().err
+
+
 # ---------------------------------------------------------------------------
 # crc32c vectorization (satellite: bitwise parity with the scalar oracle)
 # ---------------------------------------------------------------------------
