@@ -63,7 +63,7 @@ def build_config(argv: Optional[List[str]] = None):
         description="TPU-native Show, Attend and Tell",
     )
     p.add_argument(
-        "--phase", default=None, choices=["train", "eval", "test"],
+        "--phase", default=None, choices=["train", "eval", "test", "serve"],
         help="default: train, or the --config file's phase when one is given",
     )
     p.add_argument(
@@ -139,6 +139,22 @@ def build_config(argv: Optional[List[str]] = None):
              "load in Perfetto or chrome://tracing",
     )
     p.add_argument(
+        "--port", type=int, default=None, metavar="PORT",
+        help="serve phase: HTTP listen port (default Config.serve_port; "
+             "0 picks an ephemeral port)",
+    )
+    p.add_argument(
+        "--max_batch", type=int, default=None, metavar="N",
+        help="serve phase: most requests per dispatched micro-batch "
+             "(padded up to the bucket ladder, --set serve_buckets=...)",
+    )
+    p.add_argument(
+        "--max_wait_ms", type=float, default=None, metavar="MS",
+        help="serve phase: how long the batcher holds an underfull batch "
+             "open waiting for more arrivals (latency/throughput knob, "
+             "docs/SERVING.md)",
+    )
+    p.add_argument(
         "--config", default=None, metavar="JSON",
         help="load a Config JSON (e.g. the save_dir sidecar a checkpoint "
              "rode with) as the base instead of built-in defaults; "
@@ -198,6 +214,12 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(trace_export=args.trace_export)
     if args.diag_level is not None:
         config = config.replace(diag_level=args.diag_level)
+    if args.port is not None:
+        config = config.replace(serve_port=args.port)
+    if args.max_batch is not None:
+        config = config.replace(serve_max_batch=args.max_batch)
+    if args.max_wait_ms is not None:
+        config = config.replace(serve_max_wait_ms=args.max_wait_ms)
     overrides = {}
     for item in args.set:
         if "=" not in item:
@@ -320,6 +342,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 1
         # graceful SIGTERM/SIGINT: train() drained and returned normally —
         # fall through to exit 0 so the supervisor relaunches into --load
+    elif config.phase == "serve":
+        from .serve.server import serve as _serve
+
+        return _serve(config, model_file=cli["model_file"])
     elif config.phase == "eval":
         if cli["sweep"]:
             sweep = runtime.evaluate_sweep(config)

@@ -121,6 +121,28 @@ class Config:
     # let the anomaly sentinel name which tensor went non-finite.
     diag_level: str = "off"
 
+    # ---- online serving (docs/SERVING.md; no reference equivalent) ----
+    # Request-driven captioning service (sat_tpu/serve): a stdlib HTTP
+    # frontend feeding a dynamic micro-batcher that pads every dispatched
+    # batch up to a fixed ladder of shape buckets, all AOT-compiled at
+    # startup so steady state never recompiles.
+    serve_host: str = "127.0.0.1"
+    serve_port: int = 8700             # HTTP listen port (0 = ephemeral)
+    # batch-shape ladder warmed at startup; a batch of n requests runs at
+    # the smallest bucket >= n, so the device only ever sees these shapes
+    serve_buckets: Tuple[int, ...] = (1, 4, 16, 32)
+    # admission control: most requests per dispatched batch / how long the
+    # batcher holds an underfull batch open waiting for more arrivals
+    serve_max_batch: int = 32
+    serve_max_wait_ms: float = 5.0
+    # bounded request queue; submits beyond this shed with HTTP 429
+    serve_queue_depth: int = 128
+    # default per-request deadline (0 = none).  A request still queued
+    # past its deadline fails fast with HTTP 504 instead of spending
+    # device time on an answer nobody is waiting for; the X-Deadline-Ms
+    # request header overrides per request.
+    serve_deadline_ms: float = 0.0
+
     # ---- dataset-size caps (reference config.py:60-63) ----
     max_train_ann_num: Optional[int] = 1000
     max_eval_ann_num: Optional[int] = 20
@@ -234,7 +256,7 @@ class Config:
         same, /root/reference/model.py:16-21)."""
         checks = (
             ("cnn", ("vgg16", "resnet50")),
-            ("phase", ("train", "eval", "test")),
+            ("phase", ("train", "eval", "test", "serve")),
             ("optimizer", ("Adam", "RMSProp", "Momentum", "SGD")),
             ("num_initialize_layers", (1, 2)),
             ("num_attend_layers", (1, 2)),
@@ -264,6 +286,34 @@ class Config:
             raise ValueError(
                 f"Config.telemetry_buffer={self.telemetry_buffer}: must be > 0"
             )
+        buckets = tuple(self.serve_buckets)
+        if buckets != self.serve_buckets:
+            # normalize list -> tuple: this Config is a jit static arg and
+            # must stay hashable however the field arrived
+            object.__setattr__(self, "serve_buckets", buckets)
+        if (
+            not buckets
+            or any(int(b) <= 0 for b in buckets)
+            or tuple(sorted(set(buckets))) != buckets
+        ):
+            raise ValueError(
+                f"Config.serve_buckets={self.serve_buckets}: must be a "
+                "strictly increasing tuple of positive batch sizes"
+            )
+        if not 0 < self.serve_max_batch <= max(buckets):
+            raise ValueError(
+                f"Config.serve_max_batch={self.serve_max_batch}: must be in "
+                f"[1, max(serve_buckets)={max(buckets)}] — a batch larger "
+                "than the largest warmed bucket could never dispatch"
+            )
+        if self.serve_max_wait_ms < 0 or self.serve_deadline_ms < 0:
+            raise ValueError(
+                "Config.serve_max_wait_ms/serve_deadline_ms must be >= 0"
+            )
+        if self.serve_queue_depth <= 0 or self.serve_port < 0:
+            raise ValueError(
+                "Config.serve_queue_depth must be > 0 and serve_port >= 0"
+            )
 
     def replace(self, **kw: Any) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -290,7 +340,9 @@ class Config:
     def from_dict(cls, raw: Dict[str, Any]) -> "Config":
         names = {f.name for f in dataclasses.fields(cls)}
         kw = {k: v for k, v in raw.items() if k in names}
-        for key in ("mesh_shape", "mesh_axes"):
+        # JSON has no tuples; these fields must come back hashable (the
+        # Config rides jit static_argnames — a list field breaks lower())
+        for key in ("mesh_shape", "mesh_axes", "serve_buckets"):
             if key in kw and isinstance(kw[key], list):
                 kw[key] = tuple(kw[key])
         return cls(**kw)

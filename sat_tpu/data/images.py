@@ -55,6 +55,14 @@ class ImageLoader:
         self.size = size
         self.raw = raw
 
+    def _finish_decode(self, image: np.ndarray) -> np.ndarray:
+        """Shared post-codec tail: BGR → RGB, resize, contiguous uint8."""
+        import cv2
+
+        image = image[:, :, ::-1]  # BGR → RGB
+        image = cv2.resize(image, (self.size, self.size))
+        return np.ascontiguousarray(image)
+
     def load_raw(self, image_file: str) -> np.ndarray:
         """Decode → RGB → resize, stopping at the uint8 tensor.  This is
         the canonical post-resize row format the shard cache persists
@@ -67,14 +75,31 @@ class ImageLoader:
         image = cv2.imread(image_file)
         if image is None:
             raise FileNotFoundError(f"cannot decode image: {image_file}")
-        image = image[:, :, ::-1]  # BGR → RGB
-        image = cv2.resize(image, (self.size, self.size))
-        return np.ascontiguousarray(image)
+        return self._finish_decode(image)
+
+    def decode_raw(self, data: bytes) -> np.ndarray:
+        """In-memory twin of load_raw for the serving frontend
+        (sat_tpu/serve): cv2.imdecode of POSTed bytes runs the identical
+        BGR→RGB→resize tail, so a JPEG uploaded over HTTP preprocesses
+        bitwise-identically to the same file read from disk."""
+        import cv2
+
+        image = cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)
+        if image is None:
+            raise ValueError("cannot decode image bytes (not a JPEG/PNG?)")
+        return self._finish_decode(image)
 
     def load_image(self, image_file: str) -> np.ndarray:
         image = self.load_raw(image_file)
         if self.raw:
             return image  # uint8 RGB, device finishes
+        return image.astype(np.float32) - self.mean
+
+    def load_bytes(self, data: bytes) -> np.ndarray:
+        """decode_raw + this loader's preprocessing mode (see load_image)."""
+        image = self.decode_raw(data)
+        if self.raw:
+            return image
         return image.astype(np.float32) - self.mean
 
     def load_images(self, image_files: Sequence[str]) -> np.ndarray:

@@ -70,12 +70,28 @@ class Vocabulary:
 
     def get_sentence(self, idxs: Sequence[int]) -> str:
         """Indices → detokenized sentence, truncated at the first '.'
-        (reference vocabulary.py:53-63)."""
-        words = [self.words[int(i)] for i in idxs]
-        if not words or words[-1] != ".":
-            words.append(".")
-        length = int(np.argmax(np.array(words) == ".")) + 1
-        words = words[:length]
+        (reference vocabulary.py:53-63).
+
+        Hardened for beam-search output rows, which are fixed-width [T]
+        buffers: a hypothesis that terminated on its first step arrives
+        eos-first, a padding row arrives all index-0, and masked logit
+        columns can carry indices past the end of a shrunken word list.
+        Index 0 (``<start>``, doubling as pad) and out-of-range indices
+        are never words, and a result with no words at all returns ""
+        instead of a bare "." or pad-token noise (the reference indexes
+        its word list unguarded)."""
+        words: List[str] = []
+        for i in idxs:
+            i = int(i)
+            if i <= 0 or i >= len(self.words):
+                continue  # <start>/pad or an overhang column with no entry
+            word = self.words[i]
+            if word == ".":
+                break
+            words.append(word)
+        if not words:
+            return ""
+        words.append(".")
         sentence = "".join(
             " " + w if not w.startswith("'") and w not in string.punctuation else w
             for w in words

@@ -1,0 +1,31 @@
+"""Online captioning service (docs/SERVING.md).
+
+The first request-driven workload in the codebase: frozen params loaded
+through the resilience lineage, ``encode + beam_search`` AOT-compiled at
+a fixed ladder of batch buckets so steady state never recompiles, a
+dynamic micro-batcher with admission control, and a stdlib HTTP frontend
+with graceful SIGTERM drain.
+
+Layering:
+
+* :mod:`engine`  — lineage param load, AOT bucket warmup, pad-to-bucket
+  dispatch through compiled executables, detokenize drain;
+* :mod:`batcher` — bounded queue, max_batch/max_wait_ms gathering,
+  deadlines, 429 shed, double-buffered dispatch chain;
+* :mod:`server`  — ThreadingHTTPServer frontend (POST /caption,
+  GET /healthz, GET /stats), drain sequencing, the ``serve()`` CLI entry.
+"""
+
+from .batcher import MicroBatcher, Rejected, Request
+from .engine import ServeEngine, load_serving_state
+from .server import CaptionServer, serve
+
+__all__ = [
+    "CaptionServer",
+    "MicroBatcher",
+    "Rejected",
+    "Request",
+    "ServeEngine",
+    "load_serving_state",
+    "serve",
+]

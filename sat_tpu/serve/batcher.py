@@ -1,0 +1,250 @@
+"""Dynamic micro-batcher: bounded admission queue → pad-to-bucket batches.
+
+PERF.md's decode measurements show the single-program beam search is
+dispatch-latency-bound at production batch sizes — one more image in the
+batch is nearly free, one more dispatch is not.  The batcher converts
+that headroom into request throughput: requests accumulate in a bounded
+queue, the dispatch thread gathers up to ``max_batch`` of them (holding
+an underfull batch open at most ``max_wait_ms``), pads the batch to the
+engine's bucket ladder, and dispatches.
+
+Admission control and flow:
+
+* a full queue sheds immediately — ``Rejected(429)`` — so overload turns
+  into fast client-visible backpressure instead of unbounded latency;
+* a request whose deadline passed while it queued fails fast with 504 at
+  the dispatch boundary, never spending device time on it;
+* ``drain()`` flips the batcher into reject-new mode (503), completes
+  everything already admitted — queued *and* in flight — then stops.
+
+The dispatch chain is double-buffered exactly like
+``runtime.device_prefetch``: batch n+1 is dispatched to the device before
+batch n's results are drained, so host-side detokenization (and the HTTP
+threads' JPEG decoding) overlaps device beam search.  The only
+host↔device sync is the engine's ``decode_output`` drain.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+
+
+class Rejected(Exception):
+    """Admission refused; ``status`` is the HTTP code the frontend maps."""
+
+    def __init__(self, status: int, reason: str):
+        super().__init__(reason)
+        self.status = status
+        self.reason = reason
+
+
+@dataclass
+class Request:
+    """One admitted caption request; ``done`` fires with either ``result``
+    (the engine's per-image dict) or ``error`` (http status, message)."""
+
+    image: np.ndarray
+    t_submit_ns: int
+    deadline_unix: Optional[float] = None
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[Dict[str, Any]] = None
+    error: Optional[Tuple[int, str]] = None
+    bucket: Optional[int] = None
+
+    def fail(self, status: int, reason: str) -> None:
+        self.error = (status, reason)
+        self.done.set()
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        engine,
+        max_batch: Optional[int] = None,
+        max_wait_ms: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+        tel=None,
+        pipeline_depth: int = 1,
+    ) -> None:
+        config = engine.config
+        self.engine = engine
+        self.max_batch = int(
+            max_batch if max_batch is not None else config.serve_max_batch
+        )
+        wait_ms = (
+            max_wait_ms if max_wait_ms is not None else config.serve_max_wait_ms
+        )
+        self.max_wait_s = wait_ms / 1e3
+        depth = int(
+            queue_depth if queue_depth is not None else config.serve_queue_depth
+        )
+        self._q: "queue.Queue[Request]" = queue.Queue(maxsize=depth)
+        self._tel = tel if tel is not None else telemetry.get()
+        # in-flight dispatches held before draining (device_prefetch's
+        # ``ahead``); 0 degrades to fully synchronous dispatch→drain
+        self.pipeline_depth = max(0, int(pipeline_depth))
+        self._draining = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- admission (called from HTTP worker threads) -----------------------
+
+    def submit(
+        self, image: np.ndarray, deadline_unix: Optional[float] = None
+    ) -> Request:
+        """Admit one preprocessed image; raises Rejected(503) while
+        draining and Rejected(429) when the queue is full."""
+        if self._draining.is_set():
+            self._tel.count("serve/rejected_draining")
+            raise Rejected(503, "server is draining; not accepting work")
+        req = Request(
+            image=image,
+            t_submit_ns=time.perf_counter_ns(),
+            deadline_unix=deadline_unix,
+        )
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            self._tel.count("serve/shed")
+            raise Rejected(
+                429, f"queue full ({self._q.maxsize} waiting); shed"
+            ) from None
+        self._tel.count("serve/submitted")
+        self._tel.gauge("serve/queue_depth", self._q.qsize())
+        return req
+
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="sat-serve-batcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def drain(self, timeout: Optional[float] = 60.0) -> None:
+        """Graceful stop: new submits reject (503), everything already
+        admitted is dispatched, completed and signalled, then the
+        dispatch thread exits."""
+        self._draining.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    # -- dispatch loop -----------------------------------------------------
+
+    def _gather(self) -> Optional[List[Request]]:
+        """Block for the first request (polling the drain flag), then hold
+        the batch open up to ``max_wait_s`` or until ``max_batch``.
+        Returns None when draining and the queue is empty."""
+        while True:
+            try:
+                first = self._q.get(timeout=0.05)
+                break
+            except queue.Empty:
+                if self._draining.is_set():
+                    return None
+        batch = [first]
+        flush_at = time.monotonic() + self.max_wait_s
+        while len(batch) < self.max_batch:
+            wait = flush_at - time.monotonic()
+            if wait <= 0:
+                break
+            try:
+                batch.append(self._q.get(timeout=wait))
+            except queue.Empty:
+                break
+        return batch
+
+    def _admit(self, batch: List[Request]) -> List[Request]:
+        """Queue-wait accounting + deadline triage at the dispatch
+        boundary: expired requests fail fast (504) without device time."""
+        now_ns = time.perf_counter_ns()
+        now_unix = time.time()
+        live = []
+        for r in batch:
+            self._tel.record(
+                "serve/queue_wait", r.t_submit_ns, now_ns - r.t_submit_ns
+            )
+            if r.deadline_unix is not None and now_unix > r.deadline_unix:
+                self._tel.count("serve/expired")
+                r.fail(504, "deadline expired while queued")
+            else:
+                live.append(r)
+        return live
+
+    def _dispatch(self, live: List[Request]):
+        t0 = time.perf_counter_ns()
+        batch, bucket = self.engine.pad_batch([r.image for r in live])
+        out = self.engine.dispatch(batch)
+        self._tel.record("serve/dispatch", t0, time.perf_counter_ns() - t0)
+        self._tel.count("serve/batches")
+        self._tel.count(f"serve/bucket_{bucket}")
+        self._tel.count("serve/padded_rows", bucket - len(live))
+        for r in live:
+            r.bucket = bucket
+        return out
+
+    def _finish(self, entry) -> None:
+        out, live = entry
+        try:
+            t0 = time.perf_counter_ns()
+            results = self.engine.decode_output(out, len(live))
+            self._tel.record("serve/detok", t0, time.perf_counter_ns() - t0)
+        except Exception as e:  # keep serving; fail only this batch
+            self._tel.count("serve/detok_errors")
+            for r in live:
+                if not r.done.is_set():
+                    r.fail(500, f"decode failed: {e}")
+            return
+        for r, result in zip(live, results):
+            r.result = result
+            r.done.set()
+            self._tel.count("serve/completed")
+
+    def _loop(self) -> None:
+        inflight: "deque" = deque()
+        while True:
+            if inflight and self._q.qsize() == 0:
+                # Nothing to gather right now: flush the oldest in-flight
+                # batch instead of parking in _gather while its requesters
+                # wait on a device that may already be done.  Overlap
+                # still happens under load — the queue is non-empty then,
+                # so dispatch n+1 precedes this drain of n.
+                self._finish(inflight.popleft())
+                continue
+            batch = self._gather()
+            self._tel.gauge("serve/queue_depth", self._q.qsize())
+            if batch is None:
+                break
+            live = self._admit(batch)
+            if not live:
+                continue
+            try:
+                out = self._dispatch(live)
+            except Exception as e:  # device/shape failure: fail the batch
+                self._tel.count("serve/dispatch_errors")
+                for r in live:
+                    r.fail(500, f"dispatch failed: {e}")
+                continue
+            inflight.append((out, live))
+            while len(inflight) > self.pipeline_depth:
+                self._finish(inflight.popleft())
+        while inflight:  # drain: complete what the device still owes
+            self._finish(inflight.popleft())

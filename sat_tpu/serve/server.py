@@ -1,0 +1,373 @@
+"""HTTP frontend for the captioning service (docs/SERVING.md).
+
+A stdlib ``ThreadingHTTPServer`` — one Python thread per in-flight
+request, which is exactly the concurrency this workload wants: request
+threads spend their time in the JPEG codec (releases the GIL) or parked
+on an Event while the batcher owns the device, so host preprocessing of
+request n+1 overlaps device decode of batch n with no async framework.
+
+Endpoints:
+
+* ``POST /caption`` — body: JPEG/PNG bytes.  200 → ``{"captions": [{
+  "caption", "log_prob", "prob"}, ...beam-ordered], "bucket",
+  "model_step"}``.  400 undecodable body, 429 queue full (shed), 503
+  draining, 504 deadline/timeout.  ``X-Deadline-Ms`` (integer) overrides
+  ``Config.serve_deadline_ms`` per request.
+* ``GET /healthz`` — readiness + the run-health heartbeat payload
+  (telemetry.Heartbeat — same fields watchers poll from heartbeat.json).
+  200 ready, 503 draining/stopped: a load balancer needs only the code.
+* ``GET /stats`` — queue depth, bucket histogram, serve counters, and
+  p50/p95/p99 latency per serve span (queue_wait / preprocess / dispatch
+  / detok / request) from the telemetry ring.
+
+Shutdown: SIGTERM/SIGINT (via ``resilience.preempt.GracefulShutdown``)
+or ``request_shutdown()`` triggers the drain sequence — readiness flips
+first, the batcher rejects new work and completes everything admitted,
+then the listener and heartbeat close and ``serve()`` returns 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from ..config import Config
+from ..data.vocabulary import Vocabulary
+from ..resilience.preempt import GracefulShutdown
+from ..telemetry.heartbeat import Heartbeat
+from .batcher import MicroBatcher, Rejected
+from .engine import ServeEngine, load_serving_state
+
+_LATENCY_SPANS = (
+    "serve/request",
+    "serve/queue_wait",
+    "serve/preprocess",
+    "serve/dispatch",
+    "serve/detok",
+)
+
+
+def _percentiles_ms(tel, name: str) -> Optional[Dict[str, Any]]:
+    """p50/p95/p99 (ms) of a span's recorded durations; None when empty.
+    Host-side accounting over the telemetry ring — no device data."""
+    data = np.asarray(tel.durations_ns(name), np.float64)  # sync-ok: host telemetry ring, not device data
+    if data.size == 0:
+        return None
+    data = np.sort(data) / 1e6
+    def pct(p: float) -> float:
+        idx = min(data.size - 1, int(p / 100.0 * data.size))
+        return round(float(data[idx]), 3)  # sync-ok: host numpy percentile
+    return {
+        "count": int(data.size),
+        "p50": pct(50),
+        "p95": pct(95),
+        "p99": pct(99),
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "sat-serve"
+
+    def log_message(self, fmt, *args):  # stderr per-request noise: off
+        pass
+
+    def _reply(self, status: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        app = self.server.app
+        if self.path.startswith("/healthz"):
+            payload, status = app.healthz()
+            self._reply(status, payload)
+        elif self.path.startswith("/stats"):
+            self._reply(200, app.stats())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:
+        app = self.server.app
+        if not self.path.startswith("/caption"):
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            length = 0
+        if length <= 0:
+            self._reply(400, {"error": "empty body; POST image bytes"})
+            return
+        body = self.rfile.read(length)
+        status, payload = app.handle_caption(
+            body, deadline_ms=self.headers.get("X-Deadline-Ms")
+        )
+        self._reply(status, payload)
+
+
+class CaptionServer:
+    """Wires engine + micro-batcher + HTTP listener + heartbeat; owns the
+    readiness flag and the drain sequence."""
+
+    # ceiling on how long a handler thread waits for its result when the
+    # request carries no deadline (a wedged device must not strand
+    # connections forever)
+    DEFAULT_WAIT_S = 120.0
+
+    def __init__(
+        self,
+        config: Config,
+        engine: ServeEngine,
+        host: Optional[str] = None,
+        port: Optional[int] = None,
+    ) -> None:
+        self.config = config
+        self.engine = engine
+        self._tel = telemetry.get()
+        # admission knobs come from THIS server's config (which may be a
+        # replace() of the engine's — e.g. a tighter queue for the same
+        # warmed engine), not the engine's defaults
+        self.batcher = MicroBatcher(
+            engine,
+            max_batch=config.serve_max_batch,
+            max_wait_ms=config.serve_max_wait_ms,
+            queue_depth=config.serve_queue_depth,
+            tel=self._tel,
+        )
+        self._host = host if host is not None else config.serve_host
+        self._requested_port = (
+            port if port is not None else config.serve_port
+        )
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._ready = False
+        self._t_start = time.time()
+        self.heartbeat: Optional[Heartbeat] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def ready(self) -> bool:
+        return self._ready
+
+    # -- request handlers (HTTP worker threads) ----------------------------
+
+    def handle_caption(
+        self, body: bytes, deadline_ms=None
+    ) -> Tuple[int, Dict[str, Any]]:
+        t_req0 = time.perf_counter_ns()
+        if not self._ready:
+            return 503, {"error": "server is draining; not accepting work"}
+        try:
+            with self._tel.span("serve/preprocess"):
+                image = self.engine.preprocess(body)
+        except Exception as e:
+            return 400, {"error": f"bad image: {e}"}
+        if deadline_ms is None or deadline_ms == "":
+            budget_ms = self.config.serve_deadline_ms
+        else:
+            try:
+                budget_ms = int(deadline_ms)
+            except (TypeError, ValueError):
+                return 400, {
+                    "error": "X-Deadline-Ms must be integer milliseconds"
+                }
+        deadline_unix = (
+            time.time() + budget_ms / 1e3 if budget_ms > 0 else None
+        )
+        try:
+            req = self.batcher.submit(image, deadline_unix=deadline_unix)
+        except Rejected as e:
+            return e.status, {"error": e.reason}
+        wait_s = (
+            budget_ms / 1e3 + 5.0 if deadline_unix else self.DEFAULT_WAIT_S
+        )
+        if not req.done.wait(timeout=wait_s):
+            self._tel.count("serve/timeouts")
+            return 504, {"error": "request timed out in service"}
+        if req.error is not None:
+            return req.error[0], {"error": req.error[1]}
+        self._tel.record(
+            "serve/request", t_req0, time.perf_counter_ns() - t_req0
+        )
+        payload = dict(req.result)
+        payload["bucket"] = req.bucket
+        payload["model_step"] = self.engine.step
+        return 200, payload
+
+    def healthz(self) -> Tuple[Dict[str, Any], int]:
+        payload = self.heartbeat.payload() if self.heartbeat else {}
+        payload.update(
+            {
+                "ready": self._ready,
+                "uptime_s": round(time.time() - self._t_start, 1),
+                "queue_depth": self.batcher.queue_depth(),
+                "buckets": list(self.engine.buckets),
+                "model_step": self.engine.step,
+            }
+        )
+        return payload, (200 if self._ready else 503)
+
+    def stats(self) -> Dict[str, Any]:
+        counters = self._tel.counters()
+        prefix = "serve/bucket_"
+        histogram = {
+            k[len(prefix):]: v
+            for k, v in counters.items()
+            if k.startswith(prefix)
+        }
+        latency = {}
+        for name in _LATENCY_SPANS:
+            p = _percentiles_ms(self._tel, name)
+            if p:
+                latency[name] = p
+        return {
+            "ready": self._ready,
+            "queue_depth": self.batcher.queue_depth(),
+            "buckets": list(self.engine.buckets),
+            "bucket_histogram": histogram,
+            "warm_compiles": self.engine.warm_compiles,
+            "compiles_since_ready": counters.get("jax/compiles", 0)
+            - self.engine.compiles_at_ready,
+            "counters": {
+                k: v
+                for k, v in counters.items()
+                if k.startswith(("serve/", "jax/"))
+            },
+            "latency_ms": latency,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "CaptionServer":
+        self.batcher.start()
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), _Handler
+        )
+        self._httpd.app = self
+        self._httpd.daemon_threads = True
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="sat-serve-http",
+            daemon=True,
+        )
+        self._http_thread.start()
+        if self.config.heartbeat_interval > 0:
+            hb_dir = self.config.telemetry_dir or os.path.join(
+                self.config.summary_dir, "telemetry"
+            )
+            try:
+                os.makedirs(hb_dir, exist_ok=True)
+                self.heartbeat = Heartbeat(
+                    os.path.join(hb_dir, "heartbeat.json"),
+                    self.config.heartbeat_interval,
+                    self._tel,
+                    static={
+                        "phase": "serve",
+                        "port": self.port,
+                        "buckets": list(self.engine.buckets),
+                        "model_step": self.engine.step,
+                    },
+                ).start()
+            except OSError:
+                self.heartbeat = None  # health still served from /healthz
+        self._ready = True
+        self._tel.gauge("serve/ready", 1)
+        return self
+
+    def request_shutdown(self) -> None:
+        """Programmatic twin of SIGTERM (tests, embedding)."""
+        self._stop.set()
+
+    def shutdown(self) -> None:
+        """Drain sequence: readiness flips first (the balancer stops
+        routing), the batcher rejects new work and completes everything
+        admitted, then the listener and heartbeat close."""
+        if self._httpd is None:
+            return
+        self._ready = False
+        self._tel.gauge("serve/ready", 0)
+        self.batcher.drain()
+        self._httpd.shutdown()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=10.0)
+            self._http_thread = None
+        self._httpd.server_close()
+        self._httpd = None
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
+
+    def serve_until_shutdown(self, shutdown=None, poll_s: float = 0.1) -> None:
+        """Block until SIGTERM/SIGINT or request_shutdown(), then drain.
+        ``shutdown`` accepts an externally managed GracefulShutdown (tests
+        install one on the main thread); by default one is installed
+        here."""
+        own = shutdown is None
+        sd = GracefulShutdown() if own else shutdown
+        try:
+            if own:
+                sd.__enter__()
+            while not sd.stop_requested and not self._stop.is_set():
+                time.sleep(poll_s)
+        finally:
+            if own:
+                sd.__exit__(None, None, None)
+            self.shutdown()
+
+
+def serve(config: Config, model_file: Optional[str] = None) -> int:
+    """CLI entry point: ``python -m sat_tpu.cli --phase serve``.
+
+    Lineage load → AOT bucket warmup → listen → drain on SIGTERM."""
+    import jax
+
+    tel = telemetry.get()
+    if not tel.enabled:
+        # /stats and /healthz are part of the serving contract: spans and
+        # counters always record in this phase (host-side work only — the
+        # tracing layer's measured overhead bar applies, no device syncs)
+        tel = telemetry.enable(capacity=config.telemetry_buffer)
+    from ..runtime import _install_compile_listener
+
+    _install_compile_listener()
+    from ..utils.compile_cache import enable as _enable_compile_cache
+
+    _enable_compile_cache(jax, name=".jax_cache", min_compile_time_secs=0.5)
+
+    vocabulary = Vocabulary(config.vocabulary_size, config.vocabulary_file)
+    state, source = load_serving_state(config, model_file=model_file)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    print(
+        f"sat_tpu: serving params from {source} (step {engine.step})",
+        file=sys.stderr,
+        flush=True,
+    )
+    engine.warmup()
+    server = CaptionServer(config, engine)
+    server.start()
+    print(
+        f"sat_tpu: captioning server listening on "
+        f"http://{config.serve_host}:{server.port}  "
+        f"(buckets {engine.buckets}, max_batch {config.serve_max_batch}, "
+        f"max_wait {config.serve_max_wait_ms}ms)",
+        file=sys.stderr,
+        flush=True,
+    )
+    server.serve_until_shutdown()
+    print("sat_tpu: serve drained cleanly", file=sys.stderr, flush=True)
+    return 0

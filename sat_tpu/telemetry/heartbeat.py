@@ -122,6 +122,12 @@ class Heartbeat:
         xla = {k[len("xla/"):]: v for k, v in gauges.items() if k.startswith("xla/")}
         if xla:
             payload["xla"] = xla
+        # serving gauges (sat_tpu/serve): readiness, queue depth, warmed
+        # buckets/compiles — one heartbeat file answers "is the server up,
+        # is the queue backing up, did steady state start recompiling"
+        srv = {k[len("serve/"):]: v for k, v in gauges.items() if k.startswith("serve/")}
+        if srv:
+            payload["serve"] = srv
         if self._sampler is not None:
             try:
                 payload.update(self._sampler() or {})
@@ -129,6 +135,12 @@ class Heartbeat:
                 pass  # device stats are best-effort, never fatal
         payload.update(self._static)
         return payload
+
+    def payload(self) -> Dict:
+        """One payload snapshot without writing the file — the serving
+        frontend's ``GET /healthz`` rides the exact fields watchers poll
+        out of heartbeat.json."""
+        return self._payload()
 
     def write_now(self) -> None:
         """One atomic write; failures warn once and never raise."""

@@ -1,0 +1,315 @@
+"""Serving load generator: closed-loop throughput + open-loop latency.
+
+Boots the full serving stack (docs/SERVING.md) against a procedurally
+initialized tiny model — fresh params saved through the checkpoint/lineage
+path, so the bench exercises the same lineage load, AOT bucket warmup,
+micro-batcher and HTTP frontend production traffic hits — then drives it
+two ways:
+
+* **closed loop**: ``--concurrency`` workers each issue ``--requests``
+  back-to-back POSTs; measures sustained throughput (the batcher should
+  ride the top bucket) and per-request latency percentiles.
+* **open loop**: Poisson arrivals at ``--rate`` req/s (seeded, so runs
+  compare like-for-like); measures the latency distribution under an
+  arrival process that does not self-throttle, plus how much the
+  admission queue shed (429s are counted, not errors — shedding under
+  overload is the contract).
+
+Prints BENCH-contract JSON lines on stdout ({"metric", "value", "unit",
+...extras} + telemetry.bench_stamp()), accepted by
+scripts/check_regression.py:
+
+* ``serve_closed_loop_throughput`` (req_per_s, higher is better)
+* ``serve_open_loop_p99_latency_ms`` (ms, lower is better)
+
+Usage: python scripts/bench_serve.py [--concurrency 8] [--requests 25]
+       [--rate 50] [--open-requests 200] [--buckets 1,4,16]
+       [--max-batch 16] [--max-wait-ms 5] [--workdir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+_T0 = time.perf_counter()
+
+
+def log(msg: str) -> None:
+    print(f"[bench_serve +{time.perf_counter() - _T0:6.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+SENTENCES = [
+    "a man riding a horse on the beach.",
+    "a group of people standing around a kitchen.",
+    "two dogs playing with a red ball in the grass.",
+    "a plate of food with rice and vegetables.",
+    "a bus driving down a city street.",
+    "a cat sitting on top of a wooden table.",
+]
+
+
+def _make_jpegs(n: int, size: int) -> list:
+    import cv2
+
+    rng = np.random.default_rng(0)
+    out = []
+    for i in range(n):
+        img = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+        img[:, : size // 2, 0] = 200  # structure, so resize is non-trivial
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        out.append(bytes(buf))
+    return out
+
+
+def _boot(args, workdir):
+    """Tiny fresh model saved through checkpoint+lineage, then the real
+    serving stack: engine warmup + CaptionServer on an ephemeral port."""
+    import jax
+
+    from sat_tpu import runtime, telemetry
+    from sat_tpu.config import Config
+    from sat_tpu.data.vocabulary import Vocabulary
+    from sat_tpu.resilience import lineage
+    from sat_tpu.serve.engine import ServeEngine, load_serving_state
+    from sat_tpu.serve.server import CaptionServer
+    from sat_tpu.train.checkpoint import save_checkpoint
+    from sat_tpu.train.step import create_train_state
+
+    vocab_file = os.path.join(workdir, "vocabulary.csv")
+    vocabulary = Vocabulary(size=50)
+    vocabulary.build(SENTENCES)
+    vocabulary.save(vocab_file)
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    config = Config(
+        phase="serve",
+        image_size=32,
+        dim_embedding=16,
+        num_lstm_units=16,
+        dim_initialize_layer=16,
+        dim_attend_layer=16,
+        dim_decode_layer=32,
+        compute_dtype="float32",
+        vocabulary_size=vocabulary.size,
+        vocabulary_file=vocab_file,
+        beam_size=2,
+        save_dir=os.path.join(workdir, "models"),
+        summary_dir=os.path.join(workdir, "summary"),
+        serve_buckets=buckets,
+        serve_max_batch=args.max_batch,
+        serve_max_wait_ms=args.max_wait_ms,
+        serve_queue_depth=args.queue_depth,
+        heartbeat_interval=0.0,
+    )
+    os.makedirs(config.save_dir, exist_ok=True)
+
+    tel = telemetry.enable(capacity=1 << 18)
+    runtime._install_compile_listener()
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    path = save_checkpoint(state, config)
+    lineage.mark_last_good(config.save_dir, int(np.asarray(state.step)))
+    log(f"fresh params saved to {path}")
+
+    state, source = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+    server = CaptionServer(config, engine, port=0).start()
+    log(f"server up on port {server.port} "
+        f"(buckets {engine.buckets}, warm_compiles {engine.warm_compiles})")
+    return server, engine, tel
+
+
+def _post(port, data, timeout=60.0):
+    """One POST; returns (status, latency_s)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/caption", data=data, method="POST",
+        headers={"Content-Type": "image/jpeg"},
+    )
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            status = r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        status = e.code
+    return status, time.perf_counter() - t0
+
+
+def _pcts(lat_s):
+    data = np.sort(np.asarray(lat_s, np.float64)) * 1e3
+    def pct(p):
+        return round(float(data[min(len(data) - 1,
+                                    int(p / 100.0 * len(data)))]), 3)
+    return {"p50": pct(50), "p95": pct(95), "p99": pct(99)}
+
+
+def closed_loop(port, jpegs, concurrency, requests):
+    """concurrency workers x requests sequential POSTs each."""
+    lats, codes = [], []
+    lock = threading.Lock()
+
+    def worker(wid):
+        local_l, local_c = [], []
+        for i in range(requests):
+            status, lat = _post(port, jpegs[(wid + i) % len(jpegs)])
+            local_c.append(status)
+            if status == 200:
+                local_l.append(lat)
+        with lock:
+            lats.extend(local_l)
+            codes.extend(local_c)
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    ok = sum(1 for c in codes if c == 200)
+    return {
+        "wall_s": wall,
+        "ok": ok,
+        "shed": sum(1 for c in codes if c == 429),
+        "throughput": ok / wall if wall > 0 else 0.0,
+        **_pcts(lats or [0.0]),
+    }
+
+
+def open_loop(port, jpegs, rate, total):
+    """Poisson arrivals at ``rate`` req/s; each request on its own
+    thread so slow responses never throttle the arrival process."""
+    rng = random.Random(0)
+    lats, codes = [], []
+    lock = threading.Lock()
+    threads = []
+
+    def fire(i):
+        status, lat = _post(port, jpegs[i % len(jpegs)])
+        with lock:
+            codes.append(status)
+            if status == 200:
+                lats.append(lat)
+
+    t0 = time.perf_counter()
+    for i in range(total):
+        time.sleep(rng.expovariate(rate))
+        t = threading.Thread(target=fire, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=120)
+    wall = time.perf_counter() - t0
+    ok = sum(1 for c in codes if c == 200)
+    return {
+        "wall_s": wall,
+        "ok": ok,
+        "shed": sum(1 for c in codes if c == 429),
+        "offered_rate": rate,
+        **_pcts(lats or [0.0]),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=25,
+                    help="closed loop: requests per worker")
+    ap.add_argument("--rate", type=float, default=50.0,
+                    help="open loop: Poisson arrival rate, req/s")
+    ap.add_argument("--open-requests", type=int, default=200,
+                    help="open loop: total arrivals")
+    ap.add_argument("--buckets", default="1,4,16")
+    ap.add_argument("--max-batch", type=int, default=16)
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--queue-depth", type=int, default=128)
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args()
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="bench_serve_")
+    made_workdir = args.workdir is None
+    server = None
+    try:
+        from sat_tpu import telemetry
+
+        server, engine, tel = _boot(args, workdir)
+        jpegs = _make_jpegs(8, engine.config.image_size)
+        port = server.port
+
+        # one warm pass so steady-state numbers exclude first-touch costs
+        _post(port, jpegs[0])
+        compiles0 = tel.counters().get("jax/compiles", 0)
+
+        closed = closed_loop(port, jpegs, args.concurrency, args.requests)
+        log(f"closed loop: {closed['ok']} ok in {closed['wall_s']:.1f}s -> "
+            f"{closed['throughput']:.1f} req/s "
+            f"(p50 {closed['p50']}ms p99 {closed['p99']}ms)")
+
+        opened = open_loop(port, jpegs, args.rate, args.open_requests)
+        log(f"open loop @ {args.rate}/s: {opened['ok']} ok, "
+            f"{opened['shed']} shed in {opened['wall_s']:.1f}s "
+            f"(p50 {opened['p50']}ms p99 {opened['p99']}ms)")
+
+        recompiles = tel.counters().get("jax/compiles", 0) - compiles0
+        log(f"steady-state XLA compiles during load: {recompiles}")
+
+        counters = tel.counters()
+        hist = {k[len("serve/bucket_"):]: v for k, v in counters.items()
+                if k.startswith("serve/bucket_")}
+        common = {
+            "buckets": args.buckets,
+            "max_batch": args.max_batch,
+            "max_wait_ms": args.max_wait_ms,
+            "bucket_histogram": hist,
+            "warm_compiles": engine.warm_compiles,
+            "steady_state_compiles": recompiles,
+            **telemetry.bench_stamp(),
+        }
+        print(json.dumps({
+            "metric": "serve_closed_loop_throughput",
+            "value": round(closed["throughput"], 2),
+            "unit": "req_per_s",
+            "concurrency": args.concurrency,
+            "requests_per_worker": args.requests,
+            "p50_ms": closed["p50"], "p95_ms": closed["p95"],
+            "p99_ms": closed["p99"],
+            **common,
+        }), flush=True)
+        print(json.dumps({
+            "metric": "serve_open_loop_p99_latency_ms",
+            "value": opened["p99"],
+            "unit": "ms",
+            "offered_rate_per_s": args.rate,
+            "completed": opened["ok"], "shed": opened["shed"],
+            "p50_ms": opened["p50"], "p95_ms": opened["p95"],
+            **common,
+        }), flush=True)
+        # shedding under overload is fine; recompiling under load is not
+        return 0 if recompiles == 0 else 1
+    finally:
+        if server is not None:
+            server.shutdown()
+        if made_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,17 @@ if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_eval_ab.json" ]; then
   echo "STAGE FAILED: bench_eval_ab (rc=$rc)"; FAILED="$FAILED bench_eval_ab"
 fi
 
+echo "=== stage 1e: serving smoke (AOT warmup + micro-batched load) ==="
+# boots the full serving stack on the chip: lineage load, per-bucket AOT
+# warmup, closed+open-loop load; exits nonzero if steady state recompiled
+timeout 600 python scripts/bench_serve.py \
+  2>"$OUT/bench_serve.log" | tee "$OUT/bench_serve.json"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_serve.json" ]; then
+  echo "STAGE FAILED: bench_serve (rc=$rc) — see $OUT/bench_serve.log"
+  FAILED="$FAILED bench_serve"
+fi
+
 echo "=== stage 2: pallas attention measurement ==="
 timeout 1800 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
 rc=${PIPESTATUS[0]}

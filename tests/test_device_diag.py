@@ -293,16 +293,32 @@ _SYNC_RE = re.compile(
 )
 
 
+def _sync_lint_targets():
+    """runtime.py plus every module of the serving subsystem — the serve
+    hot path (batcher dispatch chain, engine drain) carries the same
+    zero-hidden-syncs contract as the train/decode loops."""
+    targets = [os.path.join(REPO, "sat_tpu", "runtime.py")]
+    serve_dir = os.path.join(REPO, "sat_tpu", "serve")
+    targets.extend(
+        os.path.join(serve_dir, f)
+        for f in sorted(os.listdir(serve_dir))
+        if f.endswith(".py")
+    )
+    return targets
+
+
 def test_runtime_sync_sites_are_annotated():
-    """Every host-sync construct in runtime.py must carry a `# sync-ok`
-    marker naming its boundary — new unmarked syncs fail this lint, which
-    is the guard behind the zero-extra-syncs claim of the diag taps."""
-    path = os.path.join(REPO, "sat_tpu", "runtime.py")
+    """Every host-sync construct in runtime.py and sat_tpu/serve/ must
+    carry a `# sync-ok` marker naming its boundary — new unmarked syncs
+    fail this lint, which is the guard behind the zero-extra-syncs claim
+    of the diag taps and the serve path's one-drain-per-batch design."""
     bad = []
-    for i, line in enumerate(open(path), 1):
-        code = line.split("#", 1)[0]
-        if _SYNC_RE.search(code) and "sync-ok" not in line:
-            bad.append(f"runtime.py:{i}: {line.strip()}")
+    for path in _sync_lint_targets():
+        rel = os.path.relpath(path, REPO)
+        for i, line in enumerate(open(path), 1):
+            code = line.split("#", 1)[0]
+            if _SYNC_RE.search(code) and "sync-ok" not in line:
+                bad.append(f"{rel}:{i}: {line.strip()}")
     assert not bad, "unannotated host syncs:\n" + "\n".join(bad)
 
 

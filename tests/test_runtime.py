@@ -69,7 +69,9 @@ def test_eval_end_to_end(trained):
     ids = [r["image_id"] for r in results]
     assert len(ids) == len(set(ids)) > 0
     for r in results:
-        assert r["caption"].endswith(".")
+        # a barely-trained model may produce an eos-first beam, which
+        # detokenizes to "" (never pad-token noise or a bare ".")
+        assert r["caption"] == "" or r["caption"].endswith(".")
 
 
 def test_test_end_to_end(trained):
@@ -78,7 +80,9 @@ def test_test_end_to_end(trained):
     assert len(results) == 12                      # all fixture images
     import pandas as pd
 
-    df = pd.read_csv(config.test_result_file)
+    # keep_default_na: an eos-first beam's empty caption must read back
+    # as "" not NaN (vocabulary.load's rule)
+    df = pd.read_csv(config.test_result_file, keep_default_na=False)
     assert list(df["caption"]) == [r["caption"] for r in results]
     # a captioned JPG per input image
     rendered = [f for f in os.listdir(config.test_result_dir) if f.endswith(".jpg")]

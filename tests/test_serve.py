@@ -1,0 +1,580 @@
+"""Online serving subsystem tests (docs/SERVING.md).
+
+Pins the contracts the serving ISSUE promises:
+
+* lineage-backed frozen-param load (LAST_GOOD pointer);
+* AOT bucket warmup — compile count measured at startup, and ZERO
+  compiles during the request phase (via the jax.monitoring listener);
+* pad-to-bucket parity — padded rows never perturb real rows (bitwise),
+  and a request answers identically through any bucket;
+* micro-batcher flow control: max_wait flush, 429 shed on a full queue,
+  504 deadline expiry, drain-to-completion;
+* the HTTP surface end-to-end on CPU: boot from checkpoint, POST a
+  fixture JPEG, JSON schema, parity vs a direct beam_search_jit call,
+  SIGTERM graceful drain.
+
+Vocabulary.get_sentence edge cases live here too: tests/test_data.py is
+skipped wholesale in environments without `hypothesis`, and these pins
+guard the serving detok boundary anyway.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sat_tpu import runtime, telemetry
+from sat_tpu.config import Config
+from sat_tpu.data.vocabulary import Vocabulary
+from sat_tpu.resilience import lineage
+from sat_tpu.resilience.preempt import GracefulShutdown
+from sat_tpu.serve.batcher import MicroBatcher, Rejected
+from sat_tpu.serve.engine import (
+    ServeEngine,
+    _effective_buckets,
+    load_serving_state,
+)
+from sat_tpu.serve.server import CaptionServer
+
+from tests.test_runtime import SMALL_MODEL
+
+
+# ---------------------------------------------------------------------------
+# Vocabulary.get_sentence hardening (serving detok boundary)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_vocab() -> Vocabulary:
+    v = Vocabulary(size=50)
+    v.build(["a dog runs fast.", "a cat sits down."])
+    return v
+
+
+class TestGetSentenceEdgeCases:
+    def test_eos_first_beam_returns_empty(self):
+        v = _tiny_vocab()
+        eos = v.word2idx["."]
+        assert v.get_sentence([eos, 0, 0, 0]) == ""
+
+    def test_all_pad_row_returns_empty(self):
+        v = _tiny_vocab()
+        assert v.get_sentence([0, 0, 0, 0]) == ""
+        assert v.get_sentence([]) == ""
+        assert v.get_sentence(np.zeros(8, np.int32)) == ""
+
+    def test_out_of_range_indices_are_skipped(self):
+        v = _tiny_vocab()
+        overhang = len(v.words) + 7
+        idxs = [v.word2idx["a"], overhang, v.word2idx["dog"]]
+        assert v.get_sentence(idxs) == "a dog."
+
+    def test_pad_between_words_never_emitted(self):
+        v = _tiny_vocab()
+        idxs = [0, v.word2idx["dog"], 0, v.word2idx["runs"]]
+        assert v.get_sentence(idxs) == "dog runs."
+
+    def test_normal_sentence_round_trips(self):
+        v = _tiny_vocab()
+        idxs = v.process_sentence("a dog runs fast.")
+        assert v.get_sentence(idxs) == "a dog runs fast."
+
+    def test_numpy_row_input(self):
+        v = _tiny_vocab()
+        row = np.array(
+            v.process_sentence("a cat sits down."), np.int32
+        )
+        assert v.get_sentence(row) == "a cat sits down."
+
+
+# ---------------------------------------------------------------------------
+# Config / CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_config_validates_serve_knobs():
+    Config(phase="serve")  # serve is a legal phase
+    with pytest.raises(ValueError):
+        Config(serve_buckets=(4, 1))  # not increasing
+    with pytest.raises(ValueError):
+        Config(serve_buckets=(0, 4))  # non-positive
+    with pytest.raises(ValueError):
+        Config(serve_max_batch=64)  # exceeds max bucket
+    with pytest.raises(ValueError):
+        Config(serve_queue_depth=0)
+    with pytest.raises(ValueError):
+        Config(serve_max_wait_ms=-1.0)
+
+
+def test_config_json_round_trip_keeps_buckets_hashable(tmp_path):
+    """--config <save_dir sidecar> boot path: JSON has no tuples, but the
+    Config rides jit static_argnames and must come back hashable."""
+    path = str(tmp_path / "config.json")
+    Config(serve_buckets=(1, 8), serve_max_batch=8).save(path)
+    loaded = Config.load(path)
+    assert loaded.serve_buckets == (1, 8)
+    hash(loaded)  # raises on a list field
+    # list-valued construction normalizes too
+    direct = Config(serve_buckets=[1, 8], serve_max_batch=8)
+    assert direct.serve_buckets == (1, 8)
+    hash(direct)
+
+
+def test_cli_serve_flags():
+    from sat_tpu.cli import build_config
+
+    config, cli = build_config(
+        [
+            "--phase=serve",
+            "--port=0",
+            "--max_batch=4",
+            "--max_wait_ms=2.5",
+            "--set", "serve_buckets=1,4",
+        ]
+    )
+    assert config.phase == "serve"
+    assert config.serve_port == 0
+    assert config.serve_max_batch == 4
+    assert config.serve_max_wait_ms == 2.5
+    assert config.serve_buckets == (1, 4)
+
+
+def test_effective_buckets_geometry():
+    assert _effective_buckets((1, 4, 16, 32), 4) == (1, 4)
+    assert _effective_buckets((1, 4, 16, 32), 20) == (1, 4, 16, 32)
+    assert _effective_buckets((1, 4, 16, 32), 32) == (1, 4, 16, 32)
+    assert _effective_buckets((8,), 8) == (8,)
+
+
+# ---------------------------------------------------------------------------
+# Served engine fixture: train a tiny model, load through lineage, warm AOT
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served(coco_fixture, tmp_path_factory):
+    """Tiny trained model + warmed ServeEngine, shared by the module.
+
+    Own save/summary dirs (the coco fixture is session-scoped and shared
+    with test_runtime's trained fixture)."""
+    root = tmp_path_factory.mktemp("serve")
+    train_config = coco_fixture["config"].replace(
+        **SMALL_MODEL,
+        save_dir=os.path.join(str(root), "models"),
+        summary_dir=os.path.join(str(root), "summary"),
+    )
+    runtime.train(train_config)
+
+    config = train_config.replace(
+        phase="serve",
+        beam_size=2,
+        serve_buckets=(1, 4),
+        serve_max_batch=4,
+        serve_max_wait_ms=30.0,
+        serve_queue_depth=8,
+        heartbeat_interval=0.2,
+    )
+    tel = telemetry.enable(capacity=16384)
+    runtime._install_compile_listener()
+    vocabulary = Vocabulary(config.vocabulary_size, config.vocabulary_file)
+    state, source = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+    yield {
+        "config": config,
+        "engine": engine,
+        "tel": tel,
+        "vocabulary": vocabulary,
+        "source": source,
+    }
+    telemetry.disable()
+
+
+def _fixture_files(served, n):
+    d = served["config"].eval_image_dir
+    return [os.path.join(d, f) for f in sorted(os.listdir(d))[:n]]
+
+
+def _fixture_images(served, n):
+    loader = served["engine"].loader
+    return [loader.load_image(f) for f in _fixture_files(served, n)]
+
+
+def _zero_image(engine):
+    s = engine.config.image_size
+    return np.zeros((s, s, 3), engine._image_dtype)
+
+
+def test_loads_through_lineage_pointer(served):
+    config = served["config"]
+    step = lineage.last_good_step(config.save_dir)
+    assert step is not None  # healthy train blessed LAST_GOOD
+    assert os.path.basename(served["source"]) == f"{step}.npz"
+    assert served["engine"].step == step
+
+
+def test_warmup_aot_compiles_all_buckets(served):
+    engine, tel = served["engine"], served["tel"]
+    assert set(engine._compiled) == {1, 4}
+    # compile count measured at startup through the jax.monitoring
+    # listener: at least one event per (encode, beam) x bucket
+    assert engine.warm_compiles >= 2
+    assert engine.compiles_at_ready >= engine.warm_compiles
+    gauges = tel.gauges()
+    assert gauges.get("serve/warm_buckets") == 2
+    assert gauges.get("serve/warm_compiles") == engine.warm_compiles
+
+
+def test_pick_bucket_and_overflow(served):
+    engine = served["engine"]
+    assert engine.pick_bucket(1) == 1
+    assert [engine.pick_bucket(n) for n in (2, 3, 4)] == [4, 4, 4]
+    with pytest.raises(ValueError):
+        engine.pick_bucket(5)
+
+
+def test_padding_never_perturbs_real_rows(served):
+    """3 real images padded to bucket 4 vs the same rows in a full batch:
+    bitwise-identical words and scores, identical captions."""
+    engine = served["engine"]
+    imgs = _fixture_images(served, 4)
+    out_full = engine.dispatch(engine.pad_batch(imgs)[0])
+    full = engine.decode_output(out_full, 4)
+    out_pad = engine.dispatch(engine.pad_batch(imgs[:3])[0])
+    pad = engine.decode_output(out_pad, 3)
+    assert np.array_equal(
+        np.asarray(out_full.words)[:3], np.asarray(out_pad.words)[:3]
+    )
+    assert np.array_equal(
+        np.asarray(out_full.log_scores)[:3],
+        np.asarray(out_pad.log_scores)[:3],
+    )
+    assert full[:3] == pad
+
+
+def test_cross_bucket_caption_parity(served):
+    """One image through bucket 1 and riding row 0 of a padded bucket-4
+    batch: same caption either way."""
+    engine = served["engine"]
+    img = _fixture_images(served, 1)[0]
+    one = engine.decode_output(
+        engine.dispatch(engine.pad_batch([img])[0]), 1
+    )
+    four = engine.decode_output(
+        engine.dispatch(engine.pad_batch([img, img, img, img])[0]), 4
+    )
+    assert (
+        one[0]["captions"][0]["caption"]
+        == four[0]["captions"][0]["caption"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Micro-batcher flow control
+# ---------------------------------------------------------------------------
+
+
+def test_max_wait_flushes_underfull_batch(served):
+    engine = served["engine"]
+    b = MicroBatcher(
+        engine, max_batch=4, max_wait_ms=40.0, queue_depth=8,
+        tel=served["tel"],
+    ).start()
+    try:
+        req = b.submit(_fixture_images(served, 1)[0])
+        assert req.done.wait(timeout=30.0)
+        assert req.error is None
+        assert req.bucket == 1  # flushed underfull, padded to bucket 1
+        assert req.result["captions"]
+    finally:
+        b.drain()
+
+
+def test_full_queue_sheds_429(served):
+    engine = served["engine"]
+    # dispatch thread NOT started: the queue can only fill
+    b = MicroBatcher(
+        engine, max_batch=4, max_wait_ms=5.0, queue_depth=2,
+        tel=served["tel"],
+    )
+    img = _zero_image(engine)
+    b.submit(img)
+    b.submit(img)
+    with pytest.raises(Rejected) as exc:
+        b.submit(img)
+    assert exc.value.status == 429
+
+
+def test_expired_deadline_fails_fast_504(served):
+    engine = served["engine"]
+    b = MicroBatcher(
+        engine, max_batch=4, max_wait_ms=5.0, queue_depth=8,
+        tel=served["tel"],
+    )
+    img = _zero_image(engine)
+    expired = b.submit(img, deadline_unix=time.time() - 1.0)
+    live = b.submit(img)  # un-expired rider in the same batch
+    b.start()
+    try:
+        assert expired.done.wait(timeout=10.0)
+        assert live.done.wait(timeout=30.0)
+        assert expired.error is not None and expired.error[0] == 504
+        assert live.error is None and live.result is not None
+    finally:
+        b.drain()
+
+
+def test_drain_completes_admitted_work_then_rejects(served):
+    engine = served["engine"]
+    b = MicroBatcher(
+        engine, max_batch=2, max_wait_ms=5.0, queue_depth=8,
+        tel=served["tel"],
+    )
+    img = _zero_image(engine)
+    reqs = [b.submit(img) for _ in range(5)]
+    b.start()
+    b.drain()  # must not return before every admitted request completes
+    for r in reqs:
+        assert r.done.is_set()
+        assert r.error is None and r.result is not None
+    with pytest.raises(Rejected) as exc:
+        b.submit(img)
+    assert exc.value.status == 503
+
+
+# ---------------------------------------------------------------------------
+# HTTP end-to-end (CPU)
+# ---------------------------------------------------------------------------
+
+
+def _post(port, data, headers=None, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/caption",
+        data=data,
+        method="POST",
+        headers={"Content-Type": "image/jpeg", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(port, path, timeout=30):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        ) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _burst(port, data, n):
+    """n concurrent POSTs released together; returns [(status, payload)]."""
+    barrier = threading.Barrier(n)
+    results = [None] * n
+
+    def client(i):
+        barrier.wait()
+        results[i] = _post(port, data)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    return [r for r in results if r is not None]
+
+
+def test_e2e_boot_post_schema_parity_zero_recompiles(served):
+    import jax
+
+    from sat_tpu.models.captioner import encode
+    from sat_tpu.ops.beam_search import beam_search_jit
+
+    config, engine, tel = served["config"], served["engine"], served["tel"]
+    vocab = served["vocabulary"]
+    server = CaptionServer(config, engine, port=0).start()
+    try:
+        port = server.port
+
+        # healthz: ready, riding the heartbeat payload
+        status, health = _get(port, "/healthz")
+        assert status == 200
+        assert health["ready"] is True
+        assert health["buckets"] == [1, 4]
+        assert health["model_step"] == engine.step
+        assert health["phase"] == "serve"  # heartbeat static fields
+        assert "run_id" in health and "rss_mb" in health
+
+        image_file = _fixture_files(served, 1)[0]
+        jpeg = open(image_file, "rb").read()
+
+        # parity oracle FIRST (it compiles its own jit programs), then
+        # snapshot the compile counter for the zero-recompile assertion
+        img = engine.loader.load_image(image_file)
+
+        @jax.jit
+        def enc(variables, images):
+            return encode(variables, config, images, train=False)[0]
+
+        contexts = enc(engine._variables, img[None])
+        direct = beam_search_jit(
+            engine._decoder_params,
+            config,
+            contexts,
+            engine.eos_id,
+            beam_size=config.beam_size,
+            valid_size=len(vocab.words),
+        )
+        d_words = np.asarray(direct.words)
+        d_scores = np.asarray(direct.log_scores)
+        d_len = max(1, int(np.asarray(direct.lengths)[0, 0]))
+        expected = vocab.get_sentence(d_words[0, 0, :d_len])
+
+        compiles0 = tel.counters().get("jax/compiles", 0)
+
+        status, payload = _post(port, jpeg)
+        assert status == 200
+        assert set(payload) >= {"captions", "bucket", "model_step"}
+        assert payload["bucket"] == 1
+        assert payload["model_step"] == engine.step
+        caps = payload["captions"]
+        assert isinstance(caps, list) and len(caps) == config.beam_size
+        for c in caps:
+            assert isinstance(c["caption"], str)
+            assert isinstance(c["log_prob"], float)
+            assert 0.0 <= c["prob"] <= 1.0
+        # beam-ordered: best hypothesis first
+        assert caps[0]["log_prob"] >= caps[-1]["log_prob"]
+
+        # parity with the direct jit path on the same image
+        assert caps[0]["caption"] == expected
+        assert np.isclose(
+            caps[0]["log_prob"], float(d_scores[0, 0]), atol=1e-5
+        )
+
+        # a concurrent burst that fills bucket 4
+        statuses = _burst(port, jpeg, n=6)
+        assert len(statuses) == 6
+        assert all(s == 200 for s, _ in statuses)
+        assert all(
+            p["captions"][0]["caption"] == expected for _, p in statuses
+        )
+
+        # THE serving guarantee: zero XLA compiles in the request phase
+        assert tel.counters().get("jax/compiles", 0) == compiles0
+
+        status, stats = _get(port, "/stats")
+        assert status == 200
+        assert stats["ready"] is True
+        # the oracle's own jit compiles above count since ready; the
+        # request phase added nothing on top of that baseline
+        assert (
+            stats["compiles_since_ready"]
+            == compiles0 - engine.compiles_at_ready
+        )
+        assert stats["buckets"] == [1, 4]
+        hist = stats["bucket_histogram"]
+        assert "1" in hist  # the single POST
+        assert sum(hist.values()) >= 2  # single + at least one burst batch
+        for span in (
+            "serve/request",
+            "serve/queue_wait",
+            "serve/preprocess",
+            "serve/dispatch",
+            "serve/detok",
+        ):
+            assert span in stats["latency_ms"]
+            assert stats["latency_ms"][span]["p50"] >= 0.0
+        assert stats["counters"].get("serve/completed", 0) >= 7
+    finally:
+        server.shutdown()
+    assert server._httpd is None
+
+
+def test_e2e_bad_body_and_deadline_header(served):
+    server = CaptionServer(served["config"], served["engine"], port=0)
+    server.start()
+    try:
+        port = server.port
+        status, payload = _post(port, b"not a jpeg")
+        assert status == 400
+        assert "error" in payload
+        status, payload = _post(
+            port, b"\xff\xd8junk", headers={"X-Deadline-Ms": "abc"}
+        )
+        assert status == 400
+        # unknown routes
+        status, _ = _get(port, "/nope")
+        assert status == 404
+    finally:
+        server.shutdown()
+
+
+def test_e2e_full_queue_sheds_429(served):
+    """A tight queue behind a slow batch window sheds concurrent load
+    with 429 while still answering some requests 200."""
+    config = served["config"].replace(
+        serve_queue_depth=1, serve_max_batch=2, serve_max_wait_ms=500.0
+    )
+    server = CaptionServer(config, served["engine"], port=0).start()
+    try:
+        port = server.port
+        jpeg = open(_fixture_files(served, 1)[0], "rb").read()
+        codes = []
+        for _ in range(3):  # burst until the race produces a shed
+            codes = [s for s, _ in _burst(port, jpeg, n=10)]
+            if 429 in codes:
+                break
+        assert 200 in codes
+        assert 429 in codes
+        assert served["tel"].counters().get("serve/shed", 0) >= 1
+    finally:
+        server.shutdown()
+
+
+def test_e2e_sigterm_drains_to_completion(served):
+    """SIGTERM mid-traffic: the in-flight POST completes 200, a request
+    sitting in the queue at signal time still completes, and post-drain
+    submits are rejected 503."""
+    config, engine = served["config"], served["engine"]
+    server = CaptionServer(config, engine, port=0).start()
+    port = server.port
+    jpeg = open(_fixture_files(served, 1)[0], "rb").read()
+    results = {}
+
+    def client():
+        results["resp"] = _post(port, jpeg)
+        # leave one request admitted-but-queued, then preempt: drain
+        # must complete it before the server exits
+        results["queued"] = server.batcher.submit(_zero_image(engine))
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    t = threading.Thread(target=client, daemon=True)
+    with GracefulShutdown() as sd:
+        t.start()
+        server.serve_until_shutdown(shutdown=sd, poll_s=0.02)
+        assert sd.stop_requested and sd.signal_name == "SIGTERM"
+    t.join(timeout=10)
+
+    status, payload = results["resp"]
+    assert status == 200 and payload["captions"]
+    queued = results["queued"]
+    assert queued.done.is_set()
+    assert queued.error is None and queued.result is not None
+    assert not server.ready
+    assert server._httpd is None  # listener closed
+    with pytest.raises(Rejected) as exc:
+        server.batcher.submit(_zero_image(engine))
+    assert exc.value.status == 503
