@@ -82,6 +82,18 @@ def _error_line(error: str, **extras) -> str:
         "vs_baseline": None,
         "error": error,
     }
+    # provenance stamp (schema_version / git_sha / run_id / host), same as
+    # every measured row: an error row an infra-skip decision hangs off
+    # (scripts/check_regression.py exit 3) must say which commit and
+    # machine failed to measure.  telemetry.bench_stamp is jax-free, so
+    # the orchestrator's no-jax rule holds; best-effort because the error
+    # path must never be the thing that crashes.
+    try:
+        from sat_tpu import telemetry as _tel
+
+        err.update(_tel.bench_stamp())
+    except Exception:
+        pass
     err.update(extras)
     return json.dumps(err)
 

@@ -155,6 +155,26 @@ def build_config(argv: Optional[List[str]] = None):
              "docs/SERVING.md)",
     )
     p.add_argument(
+        "--supervise", action="store_true",
+        help="crash-only restart loop (docs/RESILIENCE.md): keep this "
+             "process jax-free and run the real work in a child; a child "
+             "that crashes, is killed, or is aborted by the hang watchdog "
+             "(exit code 86) is relaunched with --load so it resumes from "
+             "the LAST_GOOD checkpoint, with jittered exponential backoff "
+             "and a bounded restart budget",
+    )
+    p.add_argument(
+        "--max_restarts", type=int, default=None, metavar="N",
+        help="--supervise restart budget (default "
+             "Config.supervise_max_restarts)",
+    )
+    p.add_argument(
+        "--watchdog", type=float, default=None, metavar="SEC",
+        help="arm the hang/wedge watchdog with this observer poll interval "
+             "(sets watchdog_interval; per-phase deadlines via --set "
+             "watchdog_step_s=... etc.; 0 disables — the default)",
+    )
+    p.add_argument(
         "--config", default=None, metavar="JSON",
         help="load a Config JSON (e.g. the save_dir sidecar a checkpoint "
              "rode with) as the base instead of built-in defaults; "
@@ -220,6 +240,8 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(serve_max_batch=args.max_batch)
     if args.max_wait_ms is not None:
         config = config.replace(serve_max_wait_ms=args.max_wait_ms)
+    if args.watchdog is not None:
+        config = config.replace(watchdog_interval=args.watchdog)
     overrides = {}
     for item in args.set:
         if "=" not in item:
@@ -244,6 +266,8 @@ def build_config(argv: Optional[List[str]] = None):
         "cnn_model_file": args.cnn_model_file,
         "sweep": args.sweep,
         "print_config": args.print_config,
+        "supervise": args.supervise,
+        "max_restarts": args.max_restarts,
     }
     return config, cli
 
@@ -286,6 +310,23 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         print(json.dumps(config.to_dict(), indent=2, sort_keys=True))
         return 0
+
+    if cli["supervise"]:
+        # the supervisor parent must NEVER import jax: the failure it
+        # exists to outlive is device init wedging uninterruptibly, so
+        # dispatch to the restart loop before the jax bootstrap below.
+        # The child re-enters this CLI without --supervise/--max_restarts.
+        from .resilience.supervisor import supervise
+
+        return supervise(
+            list(argv) if argv is not None else list(sys.argv[1:]),
+            max_restarts=(
+                cli["max_restarts"]
+                if cli["max_restarts"] is not None
+                else config.supervise_max_restarts
+            ),
+            backoff_base_s=config.supervise_backoff_s,
+        )
 
     # multi-host bootstrap first, before any other jax use (no-op unless a
     # launcher/env signals a cluster — see parallel.mesh)

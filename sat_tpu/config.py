@@ -94,6 +94,24 @@ class Config:
     # writes (checkpoints, shard cache, manifests, caption files)
     io_retries: int = 3
     io_retry_base_s: float = 0.05
+    # Progress watchdog (resilience/watchdog.py): observer-thread poll
+    # cadence in seconds; 0 disables the watchdog entirely.  Each tracked
+    # phase gets a deadline below (seconds; 0 disables that phase) that
+    # is enforced only once the phase has completed at least once, so a
+    # cold first-step compile never false-trips a steady-state deadline.
+    # On a blown deadline the escalation ladder runs: watchdog/* gauges
+    # -> all-thread stack dump + trace flush -> abort with exit code 86
+    # after the async checkpoint writer lands LAST_GOOD.
+    watchdog_interval: float = 0.0
+    watchdog_step_s: float = 1800.0        # whole loop body (the net)
+    watchdog_data_wait_s: float = 600.0    # host input pipeline
+    watchdog_dispatch_s: float = 900.0     # device step dispatch
+    watchdog_checkpoint_s: float = 900.0   # checkpoint enqueue/flush
+    watchdog_grace_s: float = 2.0          # stack dump -> abort delay
+    # Crash-only supervisor (--supervise): restart budget and first-
+    # restart backoff (jittered exponential, resilience.retry's policy)
+    supervise_max_restarts: int = 3
+    supervise_backoff_s: float = 1.0
 
     # ---- telemetry (docs/OBSERVABILITY.md; no reference equivalent) ----
     # Host-side span tracing + run-health heartbeat.  Off by default:
@@ -142,6 +160,12 @@ class Config:
     # device time on an answer nobody is waiting for; the X-Deadline-Ms
     # request header overrides per request.
     serve_deadline_ms: float = 0.0
+    # in-flight batch watchdog (0 = unbounded, the pre-watchdog
+    # behavior): a result drain stuck longer than this fails the batch's
+    # requests with 500, counts serve/wedged_batches, flips /healthz to
+    # 503 "degraded", and triggers an engine re-warm — a wedged device
+    # dispatch degrades the service instead of hanging it forever
+    serve_wedge_timeout_ms: float = 0.0
 
     # ---- dataset-size caps (reference config.py:60-63) ----
     max_train_ann_num: Optional[int] = 1000
@@ -306,13 +330,36 @@ class Config:
                 f"[1, max(serve_buckets)={max(buckets)}] — a batch larger "
                 "than the largest warmed bucket could never dispatch"
             )
-        if self.serve_max_wait_ms < 0 or self.serve_deadline_ms < 0:
+        if (
+            self.serve_max_wait_ms < 0
+            or self.serve_deadline_ms < 0
+            or self.serve_wedge_timeout_ms < 0
+        ):
             raise ValueError(
-                "Config.serve_max_wait_ms/serve_deadline_ms must be >= 0"
+                "Config.serve_max_wait_ms/serve_deadline_ms/"
+                "serve_wedge_timeout_ms must be >= 0"
             )
         if self.serve_queue_depth <= 0 or self.serve_port < 0:
             raise ValueError(
                 "Config.serve_queue_depth must be > 0 and serve_port >= 0"
+            )
+        for name in (
+            "watchdog_interval",
+            "watchdog_step_s",
+            "watchdog_data_wait_s",
+            "watchdog_dispatch_s",
+            "watchdog_checkpoint_s",
+            "watchdog_grace_s",
+            "supervise_backoff_s",
+        ):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"Config.{name}={getattr(self, name)}: must be >= 0"
+                )
+        if self.supervise_max_restarts < 0:
+            raise ValueError(
+                f"Config.supervise_max_restarts={self.supervise_max_restarts}: "
+                "must be >= 0"
             )
 
     def replace(self, **kw: Any) -> "Config":

@@ -100,6 +100,22 @@ def shard_train_state(state: TrainState, config: Config, mesh: Mesh) -> TrainSta
     return jax.device_put(state, train_state_shardings(state, config, mesh))
 
 
+def reshard_train_state(state: TrainState, config: Config, mesh: Mesh) -> TrainState:
+    """Elastic resume: place a restored TrainState onto *whatever mesh the
+    current process has* — which need not match the mesh the checkpoint
+    was written under (the lineage sidecar records that one).
+
+    This works because checkpoints are always host-flat FULL arrays
+    (``train.checkpoint.state_to_flat`` all-gathers sharded leaves before
+    the write), so a topology change is a pure re-placement decided by
+    the same shape-keyed rules as a fresh start: an 8-chip checkpoint
+    restored on 4 or 1 chips yields bitwise-identical state, just laid
+    out differently.  Kept as a named entry point (rather than callers
+    reusing :func:`shard_train_state`) so the elastic contract has a
+    place to live and be tested against."""
+    return shard_train_state(state, config, mesh)
+
+
 def shard_batch(batch: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
     """Place a global batch dict onto the mesh, dim 0 over 'data'.
 

@@ -10,7 +10,13 @@ Four recovery paths, each provable under deterministic fault injection
 * **NaN/diverging steps** → the log-boundary anomaly sentinel with
   ``warn | skip | rollback`` policies (:mod:`.sentinel`);
 * **flaky storage** → classified, jittered-backoff IO retries
-  (:mod:`.retry`).
+  (:mod:`.retry`);
+* **silent wedges** → the zero-sync progress watchdog's escalation
+  ladder (gauges → stack dump → abort, :mod:`.watchdog`) under the
+  crash-only ``--supervise`` restart loop (:mod:`.supervisor`), resuming
+  from ``LAST_GOOD`` on whatever device topology is available now
+  (the lineage sidecar records the topology the checkpoint was written
+  under).
 
 Nothing here imports jax at module level; the injection harness
 (:mod:`.faultinject`) is inert unless ``SAT_FI_*`` env vars arm it.
@@ -32,13 +38,16 @@ from .lineage import (
     last_good_checkpoint,
     last_good_step,
     mark_last_good,
+    read_sidecar_topology,
     sidecar_path,
     verify_checkpoint,
     write_sidecar,
 )
 from .preempt import GracefulShutdown
-from .retry import configure, is_retryable, retry_io
+from .retry import backoff_delay, configure, is_retryable, retry_io
 from .sentinel import AnomalySentinel
+from .supervisor import supervise
+from .watchdog import WATCHDOG_EXIT_CODE, Watchdog
 
 __all__ = [
     "AnomalySentinel",
@@ -47,7 +56,10 @@ __all__ = [
     "GracefulShutdown",
     "InjectedIOError",
     "SimulatedPreemption",
+    "WATCHDOG_EXIT_CODE",
+    "Watchdog",
     "apply_retention",
+    "backoff_delay",
     "checkpoint_steps",
     "configure",
     "corrupt_byte",
@@ -57,9 +69,11 @@ __all__ = [
     "last_good_checkpoint",
     "last_good_step",
     "mark_last_good",
+    "read_sidecar_topology",
     "reset_io_faults",
     "retry_io",
     "sidecar_path",
+    "supervise",
     "verify_checkpoint",
     "write_sidecar",
 ]

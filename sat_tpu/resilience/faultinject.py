@@ -33,6 +33,18 @@ Knobs::
     SAT_FI_IO_FAILURES=n[:sub] the first n ``retry_io`` attempts whose
                                description contains ``sub`` (all, when no
                                ``sub``) raise a retryable InjectedIOError
+    SAT_FI_WEDGE_AT_STEP=k     wedge the train loop before step k is
+                               dispatched: the thread parks in a sleep
+                               loop, making no progress, exactly like a
+                               silently hung device dispatch (the
+                               watchdog is expected to detect and abort)
+    SAT_FI_SLOW_STEP_MS=m      add m milliseconds of host-side stall to
+                               every step (a degraded-but-alive device;
+                               the watchdog must NOT fire)
+    SAT_FI_WEDGE_SERVE_BATCH=n wedge the n-th (1-based) dispatched serve
+                               batch at the result drain: its requests
+                               must fail 500, /healthz must degrade to
+                               503, and the engine re-warms
 """
 
 from __future__ import annotations
@@ -40,6 +52,7 @@ from __future__ import annotations
 import errno
 import os
 import signal
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
@@ -80,6 +93,9 @@ class FaultPlan:
     sigterm_at_step: Optional[int] = None
     nan_at_step: Optional[int] = None
     corrupt_ckpt_step: Optional[int] = None
+    wedge_at_step: Optional[int] = None
+    slow_step_ms: Optional[int] = None
+    wedge_serve_batch: Optional[int] = None
     _fired: Dict[str, bool] = field(default_factory=dict)
 
     @classmethod
@@ -90,6 +106,9 @@ class FaultPlan:
             sigterm_at_step=_env_int(env, "SIGTERM_AT_STEP"),
             nan_at_step=_env_int(env, "NAN_AT_STEP"),
             corrupt_ckpt_step=_env_int(env, "CORRUPT_CKPT_STEP"),
+            wedge_at_step=_env_int(env, "WEDGE_AT_STEP"),
+            slow_step_ms=_env_int(env, "SLOW_STEP_MS"),
+            wedge_serve_batch=_env_int(env, "WEDGE_SERVE_BATCH"),
         )
 
     @property
@@ -99,6 +118,9 @@ class FaultPlan:
             and self.sigterm_at_step is None
             and self.nan_at_step is None
             and self.corrupt_ckpt_step is None
+            and self.wedge_at_step is None
+            and self.slow_step_ms is None
+            and self.wedge_serve_batch is None
         )
 
     def _once(self, key: str) -> bool:
@@ -130,10 +152,39 @@ class FaultPlan:
         import jax  # deferred: inert plans must not need jax
         import numpy as np
 
-        nan = float("nan")
+        nan = float("nan")  # sync-ok: host constant, no device value
         poisoned_params = jax.tree_util.tree_map(lambda x: x * nan, state.params)
-        poisoned_metrics = {k: np.asarray(nan, np.float32) for k in metrics}
+        poisoned_metrics = {k: np.asarray(nan, np.float32) for k in metrics}  # sync-ok: host scalars
         return state._replace(params=poisoned_params), poisoned_metrics
+
+    def maybe_wedge(self, step: int) -> None:
+        """Before dispatching ``step``: park the calling thread forever
+        (well, for an hour — long past any watchdog deadline), exactly
+        like a silently hung device dispatch.  The process makes no
+        progress until the watchdog aborts it."""
+        if self.wedge_at_step is None or step < self.wedge_at_step or not self._once("wedge"):
+            return
+        deadline = time.monotonic() + 3600.0
+        while time.monotonic() < deadline:  # interruptible only by abort
+            time.sleep(0.05)
+
+    def maybe_slow(self, step: int) -> None:
+        """Before dispatching ``step``: stall ``slow_step_ms`` of host
+        time.  Degraded-but-alive; per-phase progress keeps ticking and
+        the watchdog must stay quiet."""
+        if self.slow_step_ms is None:
+            return
+        time.sleep(self.slow_step_ms / 1e3)
+
+    def maybe_wedge_serve(self, batch_index: int) -> bool:
+        """At the serve result drain, for the ``batch_index``-th (1-based)
+        dispatched batch: report True exactly once so the batcher can
+        simulate a wedged in-flight batch without real device state."""
+        return (
+            self.wedge_serve_batch is not None
+            and batch_index == self.wedge_serve_batch
+            and self._once("wedge_serve")
+        )
 
     def maybe_corrupt_checkpoint(self, path: str, step: int) -> None:
         """After ``<step>.npz`` landed: flip one byte mid-file (bit rot /

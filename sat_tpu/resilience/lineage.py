@@ -35,6 +35,7 @@ No jax at module level: lineage is pure host IO, shared with the jax-free
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import re
 import sys
@@ -96,18 +97,42 @@ def file_sha256(path: str) -> str:
     return h.hexdigest()
 
 
-def write_sidecar(ckpt_path: str) -> str:
+def write_sidecar(ckpt_path: str, topology: Optional[dict] = None) -> str:
     """Hash the landed checkpoint and record it; the sidecar is what makes
-    later verification a byte-for-byte statement instead of a guess."""
+    later verification a byte-for-byte statement instead of a guess.
+
+    ``topology`` (optional) is the device topology the checkpoint was
+    written under — ``{"device_count", "mesh_shape", "mesh_axes",
+    "platform"}`` — appended as a JSON line AFTER the digest line.
+    :func:`verify_checkpoint` reads only the first whitespace-delimited
+    token, so the extension is invisible to every existing sidecar
+    consumer; :func:`read_sidecar_topology` is the reader.  Elastic
+    resume (docs/RESILIENCE.md) uses it to report topology changes —
+    the saved state itself is always host-flat full arrays, so restoring
+    onto a different mesh is a re-placement, not a data transform."""
     digest = retry_io(
         lambda: file_sha256(ckpt_path), desc=f"hash checkpoint {ckpt_path}"
     )
-    atomic_write(
-        sidecar_path(ckpt_path),
-        "w",
-        lambda f: f.write(f"{digest}  {os.path.basename(ckpt_path)}\n"),
-    )
+    lines = f"{digest}  {os.path.basename(ckpt_path)}\n"
+    if topology:
+        lines += json.dumps({"topology": topology}, sort_keys=True) + "\n"
+    atomic_write(sidecar_path(ckpt_path), "w", lambda f: f.write(lines))
     return digest
+
+
+def read_sidecar_topology(ckpt_path: str) -> Optional[dict]:
+    """Topology record from ``ckpt_path``'s sidecar, or None when the
+    sidecar is missing or predates the topology extension."""
+    sc = sidecar_path(ckpt_path)
+    try:
+        with open(sc) as f:
+            for line in f.read().splitlines()[1:]:
+                line = line.strip()
+                if line.startswith("{"):
+                    return json.loads(line).get("topology")
+    except (OSError, ValueError):
+        return None
+    return None
 
 
 def verify_checkpoint(ckpt_path: str) -> Tuple[bool, str]:

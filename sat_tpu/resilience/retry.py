@@ -73,7 +73,24 @@ def configure(retries: Optional[int] = None, base_delay_s: Optional[float] = Non
     if retries is not None:
         _defaults["retries"] = max(0, int(retries))
     if base_delay_s is not None:
-        _defaults["base_delay_s"] = float(base_delay_s)
+        _defaults["base_delay_s"] = float(base_delay_s)  # sync-ok: host config scalar
+
+
+def backoff_delay(
+    attempt: int,
+    *,
+    base_delay_s: float,
+    max_delay_s: float = 2.0,
+    jitter: Tuple[float, float] = (0.5, 1.5),
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Jittered exponential backoff for retry ``attempt`` (0-based):
+    ``base * 2**attempt`` capped at ``max_delay_s``, scaled by a uniform
+    draw from ``jitter``.  Shared by :func:`retry_io` and the crash-only
+    supervisor (``resilience.supervisor``) so every retry loop in the
+    fleet decorrelates the same way."""
+    delay = min(float(base_delay_s) * (2.0 ** attempt), max_delay_s)  # sync-ok: host arithmetic
+    return delay * (rng or _jitter_rng).uniform(*jitter)
 
 
 def is_retryable(exc: BaseException) -> bool:
@@ -107,7 +124,7 @@ def retry_io(
     ``SAT_FI_IO_FAILURES=n:substr`` matches against.
     """
     budget = _defaults["retries"] if retries is None else max(0, int(retries))
-    base = _defaults["base_delay_s"] if base_delay_s is None else float(base_delay_s)
+    base = _defaults["base_delay_s"] if base_delay_s is None else float(base_delay_s)  # sync-ok: host config scalar
     for attempt in range(budget + 1):
         try:
             consume_io_fault(desc)
@@ -116,8 +133,12 @@ def retry_io(
             if not is_retryable(e) or attempt == budget:
                 raise
             telemetry.count("io/retries")
-            delay = min(base * (2.0 ** attempt), max_delay_s)
-            delay *= _jitter_rng.uniform(*jitter)
+            delay = backoff_delay(
+                attempt,
+                base_delay_s=base,
+                max_delay_s=max_delay_s,
+                jitter=jitter,
+            )
             print(
                 f"sat_tpu: transient IO error on {desc} "
                 f"(attempt {attempt + 1}/{budget + 1}): {e} — "

@@ -55,7 +55,7 @@ class AnomalySentinel:
         self.policy = policy
         # loss > spike_factor * EMA(loss) counts as an anomaly (0 disables
         # spike detection; NaN/Inf detection is always on when armed)
-        self.spike_factor = float(spike_factor)
+        self.spike_factor = float(spike_factor)  # sync-ok: host config scalar
         self._ema: Optional[float] = None
         self.healthy = True
         self.last_reason = ""
@@ -80,7 +80,7 @@ class AnomalySentinel:
         # this list localizes WHICH tensor went bad
         bad = []
         for name, value in metrics.items():
-            v = float(value)
+            v = float(value)  # sync-ok: metrics already fetched at the log boundary
             if math.isnan(v) or math.isinf(v):
                 bad.append(f"{name}={v}")
         if bad:
@@ -90,7 +90,7 @@ class AnomalySentinel:
             return f"{shown} is not finite"
         loss = metrics.get("loss")
         if loss is not None and self.spike_factor > 0:
-            v = float(loss)
+            v = float(loss)  # sync-ok: metrics already fetched at the log boundary
             if self._ema is not None and v > self.spike_factor * self._ema:
                 return (
                     f"loss={v:.4g} spiked over {self.spike_factor:g}x "

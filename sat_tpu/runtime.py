@@ -43,6 +43,8 @@ from .train.checkpoint import (
 from .resilience import AnomalySentinel, FaultPlan, GracefulShutdown, lineage
 from .resilience import retry as _retry
 from .resilience.lineage import CheckpointWriteError
+from .resilience.supervisor import RESTARTS_ENV
+from .resilience.watchdog import Watchdog, deadlines_from_config
 from .train.step import TrainState, create_train_state, make_jit_train_step
 from . import telemetry
 from .utils.fileio import atomic_write
@@ -105,6 +107,20 @@ def device_prefetch(loader, ahead: int = 1):
             yield buf.popleft()
     while buf:
         yield buf.popleft()
+
+
+def _watched_iter(it, wd, name: str):
+    """Bracket every fetch from ``it`` with a watchdog phase guard, so a
+    feed that stops producing (dead worker pool, wedged host IO) trips the
+    ``data_wait`` deadline instead of hanging the loop silently."""
+    it = iter(it)
+    while True:
+        with wd.phase(name):
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+        yield item
 
 
 # ---------------------------------------------------------------------------
@@ -511,6 +527,22 @@ def train(
     # config.telemetry, the null object otherwise — the off path leaves
     # run behavior bit-for-bit unchanged
     tel = _telemetry_begin(config)
+    # incarnation number under `--supervise`: the restart loop exports it
+    # so heartbeat.json can show how many times this run has come back
+    tel.gauge("supervisor/restarts", int(os.environ.get(RESTARTS_ENV, "0") or 0))
+    # hang/wedge watchdog (docs/RESILIENCE.md): a side thread observing the
+    # phase guards below, escalating gauges → stack dump → abort with exit
+    # code 86 when a tracked phase stops completing.  Constructed always so
+    # the guards are uniform; the observer thread only runs when
+    # config.watchdog_interval > 0 (unstarted, a guard is two dict writes).
+    wd = Watchdog(
+        deadlines_from_config(config),
+        poll_s=config.watchdog_interval or 1.0,
+        grace_s=config.watchdog_grace_s,
+        dump_path=os.path.join(_telemetry_dir(config), "watchdog_stacks.txt"),
+        pre_abort=async_writer.flush if async_writer else None,
+        tel=tel,
+    )
     compile_probed = False  # train_step analyzed once, on the first batch
     import contextlib
 
@@ -540,6 +572,11 @@ def train(
                 hb.start()
         if async_writer:
             _stack.callback(async_writer.close)
+        if config.watchdog_interval > 0:
+            # LIFO: the observer stops BEFORE the writer drain above runs,
+            # so a slow final drain is never mistaken for a wedge
+            _stack.callback(wd.stop)
+            wd.start()
         # resume-aware trace window (>= start, once); the ExitStack exit
         # keeps an exception mid-window from leaving the profiler open
         prof = _stack.enter_context(ProfilerWindow(config))
@@ -575,12 +612,20 @@ def train(
                 # from the previous boundary — no extra syncs, ~1 µs/step
                 step_t0 = time.perf_counter_ns()
                 for batch in _timed_iter(
-                    wrap_feed(loader), tel, "train/data_wait"
+                    _watched_iter(wrap_feed(loader), wd, "data_wait"),
+                    tel,
+                    "train/data_wait",
                 ):
+                  # watchdog net around the whole body: a wedge landing
+                  # between the finer-grained guards still trips the
+                  # 'step' deadline (deadlines_from_config docstring)
+                  with wd.phase("step"):
                     if config.max_steps and step >= config.max_steps:
                         stopped = True
                         break
                     plan.maybe_kill(step)  # injected preemption (inert unarmed)
+                    plan.maybe_wedge(step)  # injected silent hang (inert unarmed)
+                    plan.maybe_slow(step)  # injected slow-but-alive step
                     if shutdown.stop_requested:
                         # stop at the step boundary: the final save below
                         # flushes through the writer and train() returns
@@ -609,7 +654,7 @@ def train(
                             "train_step", train_step, state, placed,
                             step_rng, tel=tel,
                         )
-                    with tel.span("train/dispatch"):
+                    with tel.span("train/dispatch"), wd.phase("dispatch"):
                         state, metrics = train_step(state, placed, step_rng)
                     prof.after_step(step, state)
                     step += 1  # == int(state.step), without a device sync
@@ -658,7 +703,7 @@ def train(
                         and step % config.save_period == 0
                         and not sentinel.suppress_save
                     ):
-                        with tel.span("train/checkpoint"):
+                        with tel.span("train/checkpoint"), wd.phase("checkpoint"):
                             ckpt_save(state, config, healthy=sentinel.healthy)
                     bar.update()
                     now = time.perf_counter_ns()
@@ -695,7 +740,14 @@ def train(
                 flush=True,
             )
         else:
-            final_path = ckpt_save(state, config, healthy=sentinel.healthy)
+            # defer(): a second (force-kill) SIGTERM arriving while the
+            # final write is in flight is held until the flush below has
+            # landed AND verified — the one window where the old behavior
+            # could kill the run between rename and verify
+            with shutdown.defer():
+                final_path = ckpt_save(state, config, healthy=sentinel.healthy)
+                if async_writer:
+                    async_writer.flush()
         if shutdown.stop_requested:
             print(
                 f"sat_tpu: stopped on {shutdown.signal_name} at step {step}; "

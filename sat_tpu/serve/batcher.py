@@ -31,11 +31,16 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .. import telemetry
+from ..resilience import faultinject
+
+
+class _WedgeTimeout(Exception):
+    """An in-flight batch's result drain exceeded serve_wedge_timeout_ms."""
 
 
 class Rejected(Exception):
@@ -74,6 +79,8 @@ class MicroBatcher:
         queue_depth: Optional[int] = None,
         tel=None,
         pipeline_depth: int = 1,
+        on_wedge: Optional[Callable[[], None]] = None,
+        wedge_timeout_ms: Optional[float] = None,
     ) -> None:
         config = engine.config
         self.engine = engine
@@ -92,6 +99,23 @@ class MicroBatcher:
         # in-flight dispatches held before draining (device_prefetch's
         # ``ahead``); 0 degrades to fully synchronous dispatch→drain
         self.pipeline_depth = max(0, int(pipeline_depth))
+        # wedge containment (docs/SERVING.md degraded health): when > 0,
+        # the result drain of each in-flight batch is bounded — a batch
+        # the device never returns fails its requests with 500 instead of
+        # stranding them, and ``on_wedge`` (the server's degrade+re-warm
+        # hook) fires.  0 keeps the drain unbounded (the default).
+        wedge_ms = (
+            wedge_timeout_ms
+            if wedge_timeout_ms is not None
+            else config.serve_wedge_timeout_ms
+        )
+        self.wedge_timeout_s = float(wedge_ms) / 1e3  # sync-ok: host config scalar
+        self.on_wedge = on_wedge
+        # armed only via SAT_FI_WEDGE_SERVE_BATCH (inert in production);
+        # captured once so the fire-once bookkeeping persists across
+        # batches
+        self._plan = faultinject.FaultPlan.from_env()
+        self._batch_index = 0  # 1-based, counted at dispatch
         self._draining = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -201,12 +225,65 @@ class MicroBatcher:
             r.bucket = bucket
         return out
 
+    def _bounded_decode(self, decode: Callable[[], Any]):
+        """Run ``decode`` in a helper thread bounded by
+        ``wedge_timeout_s``; raises :class:`_WedgeTimeout` when the device
+        never returns.  The helper is a daemon — a truly wedged drain
+        parks it forever, which is exactly the state the timeout reports
+        instead of sharing."""
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def _run():
+            try:
+                box["results"] = decode()
+            except BaseException as e:
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_run, name="sat-serve-drain", daemon=True)
+        t.start()
+        if not done.wait(timeout=self.wedge_timeout_s):
+            raise _WedgeTimeout()
+        if "error" in box:
+            raise box["error"]
+        return box["results"]
+
     def _finish(self, entry) -> None:
-        out, live = entry
+        out, live, index = entry
+
+        def _decode():
+            if self._plan.maybe_wedge_serve(index):
+                # injected stuck batch: park exactly like a drain whose
+                # device never answers (interruptible only by process exit)
+                time.sleep(3600.0)
+            return self.engine.decode_output(out, len(live))
+
         try:
             t0 = time.perf_counter_ns()
-            results = self.engine.decode_output(out, len(live))
+            if self.wedge_timeout_s > 0:
+                results = self._bounded_decode(_decode)
+            else:
+                results = _decode()
             self._tel.record("serve/detok", t0, time.perf_counter_ns() - t0)
+        except _WedgeTimeout:
+            # the batch is gone; its requesters get a fast 500 and the
+            # server's hook degrades health + re-warms the engine
+            self._tel.count("serve/wedged_batches")
+            for r in live:
+                if not r.done.is_set():
+                    r.fail(
+                        500,
+                        "in-flight batch wedged past "
+                        f"{self.wedge_timeout_s * 1e3:g}ms; results discarded",
+                    )
+            if self.on_wedge is not None:
+                try:
+                    self.on_wedge()
+                except Exception:
+                    pass  # degrading health must never kill the batcher
+            return
         except Exception as e:  # keep serving; fail only this batch
             self._tel.count("serve/detok_errors")
             for r in live:
@@ -243,7 +320,8 @@ class MicroBatcher:
                 for r in live:
                     r.fail(500, f"dispatch failed: {e}")
                 continue
-            inflight.append((out, live))
+            self._batch_index += 1
+            inflight.append((out, live, self._batch_index))
             while len(inflight) > self.pipeline_depth:
                 self._finish(inflight.popleft())
         while inflight:  # drain: complete what the device still owes

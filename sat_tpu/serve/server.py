@@ -145,6 +145,8 @@ class CaptionServer:
             max_wait_ms=config.serve_max_wait_ms,
             queue_depth=config.serve_queue_depth,
             tel=self._tel,
+            on_wedge=self._on_wedge,
+            wedge_timeout_ms=config.serve_wedge_timeout_ms,
         )
         self._host = host if host is not None else config.serve_host
         self._requested_port = (
@@ -154,6 +156,11 @@ class CaptionServer:
         self._http_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._ready = False
+        # wedged-batch degraded state (docs/SERVING.md): /healthz reports
+        # 503 "degraded" while the engine re-warms after a stuck in-flight
+        # batch; requests are still admitted (the batcher is alive) — only
+        # the balancer-facing health flips
+        self._degraded = False
         self._t_start = time.time()
         self.heartbeat: Optional[Heartbeat] = None
 
@@ -212,16 +219,58 @@ class CaptionServer:
 
     def healthz(self) -> Tuple[Dict[str, Any], int]:
         payload = self.heartbeat.payload() if self.heartbeat else {}
+        degraded = self._degraded
         payload.update(
             {
                 "ready": self._ready,
+                "status": (
+                    "degraded"
+                    if degraded
+                    else ("ok" if self._ready else "draining")
+                ),
                 "uptime_s": round(time.time() - self._t_start, 1),
                 "queue_depth": self.batcher.queue_depth(),
                 "buckets": list(self.engine.buckets),
                 "model_step": self.engine.step,
             }
         )
-        return payload, (200 if self._ready else 503)
+        return payload, (200 if self._ready and not degraded else 503)
+
+    # -- wedge containment (called from the batcher thread) ----------------
+
+    def _on_wedge(self) -> None:
+        """A stuck in-flight batch was just failed with 500s: flip health
+        to 503 "degraded" so the balancer routes away, and re-warm the
+        engine in the background — the AOT warmup rebuilds the compiled
+        ladder (cheap under the persistent compile cache) and proves the
+        device answers again before health recovers."""
+        self._degraded = True
+        self._tel.gauge("serve/degraded", 1)
+        threading.Thread(
+            target=self._rewarm, name="sat-serve-rewarm", daemon=True
+        ).start()
+
+    def _rewarm(self) -> None:
+        try:
+            self.engine.warmup()
+        except Exception as e:
+            # still wedged — stay degraded; the next wedge timeout (or an
+            # operator) escalates
+            print(
+                f"sat_tpu: serve re-warm failed ({e!r}); staying degraded",
+                file=sys.stderr,
+                flush=True,
+            )
+            return
+        self._tel.count("serve/rewarms")
+        self._degraded = False
+        self._tel.gauge("serve/degraded", 0)
+        print(
+            "sat_tpu: serve engine re-warmed after wedged batch; health "
+            "restored",
+            file=sys.stderr,
+            flush=True,
+        )
 
     def stats(self) -> Dict[str, Any]:
         counters = self._tel.counters()

@@ -128,6 +128,24 @@ class Heartbeat:
         srv = {k[len("serve/"):]: v for k, v in gauges.items() if k.startswith("serve/")}
         if srv:
             payload["serve"] = srv
+        # watchdog ladder state (resilience.watchdog): 0 ok / 1 stalled /
+        # 2 dumped / 3 aborting, plus seconds the stalled phase has been
+        # open — the heartbeat is how an outside watcher sees a stall
+        # while it is still recoverable
+        wdg = {
+            k[len("watchdog/"):]: v
+            for k, v in gauges.items()
+            if k.startswith("watchdog/")
+        }
+        if wdg:
+            payload["watchdog"] = wdg
+        sup = {
+            k[len("supervisor/"):]: v
+            for k, v in gauges.items()
+            if k.startswith("supervisor/")
+        }
+        if sup:
+            payload["supervisor"] = sup
         if self._sampler is not None:
             try:
                 payload.update(self._sampler() or {})

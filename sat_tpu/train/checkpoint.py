@@ -254,6 +254,24 @@ class AsyncCheckpointWriter:
         self.close()
 
 
+def _topology_snapshot(config: Config) -> Dict[str, Any]:
+    """Device topology the checkpoint is being written under, recorded in
+    the lineage sidecar so elastic resume (docs/RESILIENCE.md) can report
+    a topology change.  Informational only: the saved state is host-flat
+    full arrays, so a restore onto fewer (or more) chips is a
+    re-placement (``parallel.sharding.reshard_train_state``), never a
+    data transform — the snapshot exists so the change is visible, not
+    because it gates anything."""
+    devices = jax.devices()
+    return {
+        "device_count": len(devices),
+        "platform": devices[0].platform if devices else "unknown",
+        "process_count": jax.process_count(),
+        "mesh_shape": list(config.mesh_shape),
+        "mesh_axes": list(config.mesh_axes),
+    }
+
+
 def _write_flat(
     flat: Dict[str, np.ndarray],
     path: str,
@@ -277,7 +295,7 @@ def _write_flat(
     # sidecar computed later would faithfully fingerprint whatever rot
     # happened in between and the verify would bless corrupt bytes
     with telemetry.span("ckpt/sidecar"):
-        lineage.write_sidecar(path)
+        lineage.write_sidecar(path, topology=_topology_snapshot(config))
     retry_io(
         lambda: config.replace(global_step=step).save(
             os.path.join(save_dir, "config.json")
@@ -364,6 +382,30 @@ def load_flat(path: str) -> Dict[str, np.ndarray]:
     return retry_io(_read, desc=f"read checkpoint {path}")
 
 
+def _note_elastic_restore(path: str) -> None:
+    """Report when a checkpoint written under one device topology is being
+    restored under another (elastic resume).  Purely informational — the
+    host-flat checkpoint format makes the restore itself topology-free —
+    but an operator resuming an 8-chip run on 1 chip should see it said
+    out loud, and ``ckpt/elastic_restores`` makes it greppable in
+    heartbeat/bench artifacts."""
+    recorded = lineage.read_sidecar_topology(path)
+    if not recorded:
+        return
+    now = len(jax.devices())
+    then = recorded.get("device_count")
+    if then is not None and int(then) != now:
+        telemetry.count("ckpt/elastic_restores")
+        print(
+            f"sat_tpu: elastic resume — checkpoint {os.path.basename(path)} "
+            f"was written on {then} device(s) "
+            f"(mesh {recorded.get('mesh_shape')}), restoring onto {now}; "
+            "state will be re-placed on the current mesh",
+            file=sys.stderr,
+            flush=True,
+        )
+
+
 def restore_checkpoint(
     state: Any, model_file: Optional[str] = None, save_dir: Optional[str] = None
 ) -> Tuple[Any, int]:
@@ -385,6 +427,7 @@ def restore_checkpoint(
     """
     if model_file:
         flat = load_flat(model_file)
+        _note_elastic_restore(model_file)
     else:
         if not save_dir:
             raise FileNotFoundError(f"no checkpoint found (save_dir={save_dir!r})")
@@ -396,6 +439,7 @@ def restore_checkpoint(
             if ok:
                 try:
                     flat = load_flat(path)
+                    _note_elastic_restore(path)
                     break
                 except (OSError, ValueError) as e:  # verified yet unloadable
                     reason = f"load failed: {e}"

@@ -44,8 +44,17 @@ percent (defaults below, override with ``--margin name=pct``).  The
 candidate is compared against the BEST prior value so a noisy low prior
 can't mask a real regression.
 
+Infra-skip: a CANDIDATE artifact carrying ``error: device_unreachable``
+rows (bench.py's fallback line when every probe/run attempt died inside
+the device-watchdog budget) means measurement never happened — that is
+an infrastructure outage, not a metric regression.  The gate exits 3
+with a named reason so CI can mark the job skipped instead of failed;
+measured regressions in the same artifact still win (exit 2 takes
+precedence).
+
 Exit codes: 0 = no regression (or nothing comparable), 2 = regression,
-3 = incompatible schema, 1 = usage/IO error.
+3 = incompatible schema or infra-skip (candidate is an unmeasured
+device-unreachable artifact), 1 = usage/IO error.
 """
 
 from __future__ import annotations
@@ -152,6 +161,51 @@ def _rows_from_obj(obj: Any, path: str) -> List[Dict]:
             return [obj]
         return []                            # degraded row (value null)
     return []
+
+
+# error strings that mean "the run never measured anything for
+# infrastructure reasons" — candidate artifacts carrying them are an
+# infra-skip (exit 3), never a regression
+INFRA_SKIP_ERRORS = ("device_unreachable",)
+
+
+def _errors_from_obj(obj: Any) -> List[str]:
+    """Error strings carried by BENCH rows (``value`` null, ``error``
+    set — the bench orchestrator's fallback line)."""
+    if obj is None:
+        return []
+    if isinstance(obj, list):
+        errors: List[str] = []
+        for item in obj:
+            errors.extend(_errors_from_obj(item))
+        return errors
+    if not isinstance(obj, dict):
+        return []
+    if "parsed" in obj and "rc" in obj:      # bench-driver wrapper
+        return _errors_from_obj(obj.get("parsed"))
+    err = obj.get("error")
+    return [str(err)] if err else []
+
+
+def load_errors(path: str) -> List[str]:
+    """Error strings from one artifact file (same formats as load_rows)."""
+    with open(path) as f:
+        text = f.read().strip()
+    if not text:
+        return []
+    try:
+        return _errors_from_obj(json.loads(text))
+    except json.JSONDecodeError:
+        errors: List[str] = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                errors.extend(_errors_from_obj(json.loads(line)))
+            except json.JSONDecodeError:
+                continue
+        return errors
 
 
 def load_rows(path: str) -> List[Dict]:
@@ -351,6 +405,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     jobs = 0
     regressions = 0
+    candidate_errors: List[str] = []
     try:
         if args.baseline or args.current:
             if not (args.baseline and args.current):
@@ -358,17 +413,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             jobs += 1
             n, lines = check_bench([args.baseline], args.current, margins)
             regressions += n
+            candidate_errors.extend(load_errors(args.current))
             print("\n".join(lines))
         if len(files) >= 2:
             jobs += 1
             n, lines = check_bench(files[:-1], files[-1], margins)
             regressions += n
+            candidate_errors.extend(load_errors(files[-1]))
             print("\n".join(lines))
         elif files:
             # a single artifact has nothing to regress against: validate
             # it (schema + parse) and pass
             jobs += 1
             rows = load_rows(files[0])
+            candidate_errors.extend(load_errors(files[0]))
             print(
                 f"{files[0]}: {len(rows)} row(s), no prior artifacts — "
                 "nothing to gate"
@@ -396,8 +454,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error("nothing to do: pass a trajectory, --baseline/--current, "
                  "or --compile-baseline/--compile-current")
     if regressions:
+        # measured regressions outrank an infra-skip: numbers that DID
+        # land and got worse must fail the gate even if a later attempt
+        # in the same artifact hit the outage
         print(f"check_regression: {regressions} regression(s)", file=sys.stderr)
         return 2
+    skips = sorted({e for e in candidate_errors if e in INFRA_SKIP_ERRORS})
+    if skips:
+        print(
+            f"check_regression: infra-skip ({', '.join(skips)}) — the "
+            "candidate artifact records an infrastructure outage, not a "
+            "measurement; nothing was gated",
+            file=sys.stderr,
+        )
+        return 3
+    for e in sorted({e for e in candidate_errors if e not in INFRA_SKIP_ERRORS}):
+        print(
+            f"check_regression: warning: candidate carries error rows "
+            f"({e}) — not a recognized infra-skip reason",
+            file=sys.stderr,
+        )
     print("check_regression: no regressions")
     return 0
 

@@ -578,3 +578,66 @@ def test_e2e_sigterm_drains_to_completion(served):
     with pytest.raises(Rejected) as exc:
         server.batcher.submit(_zero_image(engine))
     assert exc.value.status == 503
+
+
+def test_e2e_wedged_batch_degrades_then_rewarns(served, monkeypatch):
+    """SAT_FI_WEDGE_SERVE_BATCH: a wedged in-flight batch fails its
+    requests with a fast 500, /healthz degrades to 503 "degraded" while
+    the engine re-warms, then health recovers to 200 "ok" and the next
+    request serves normally (docs/SERVING.md degraded health)."""
+    engine, tel = served["engine"], served["tel"]
+    wedged_before = tel.counters().get("serve/wedged_batches", 0)
+    rewarms_before = tel.counters().get("serve/rewarms", 0)
+
+    # hold the re-warm open long enough for the degraded window to be
+    # observable from the HTTP side (the real warmup is ~instant under
+    # the persistent compile cache)
+    real_warmup = engine.warmup
+
+    def slow_warmup(*a, **kw):
+        time.sleep(0.5)
+        return real_warmup(*a, **kw)
+
+    monkeypatch.setattr(engine, "warmup", slow_warmup)
+    # the batcher captures its FaultPlan at construction: arm before
+    monkeypatch.setenv("SAT_FI_WEDGE_SERVE_BATCH", "1")
+    config = served["config"].replace(serve_wedge_timeout_ms=250.0)
+    server = CaptionServer(config, engine, port=0).start()
+    try:
+        port = server.port
+        jpeg = open(_fixture_files(served, 1)[0], "rb").read()
+
+        # batch 1 wedges at the result drain: fast 500, not a hang
+        status, payload = _post(port, jpeg, timeout=30)
+        assert status == 500
+        assert "wedged" in payload["error"]
+        assert tel.counters().get("serve/wedged_batches", 0) == wedged_before + 1
+
+        # health degrades to 503 while the engine re-warms...
+        deadline = time.time() + 10.0
+        saw_degraded = False
+        while time.time() < deadline:
+            code, health = _get(port, "/healthz")
+            if code == 503 and health["status"] == "degraded":
+                saw_degraded = True
+                break
+            if tel.counters().get("serve/rewarms", 0) > rewarms_before:
+                break  # re-warm already finished; window closed
+            time.sleep(0.02)
+        assert saw_degraded, "degraded health window never observed"
+
+        # ...and recovers once the re-warm proves the device answers
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            code, health = _get(port, "/healthz")
+            if code == 200 and health["status"] == "ok":
+                break
+            time.sleep(0.05)
+        assert code == 200 and health["status"] == "ok"
+        assert tel.counters().get("serve/rewarms", 0) == rewarms_before + 1
+
+        # the fault fired exactly once: the next request serves normally
+        status, payload = _post(port, jpeg, timeout=60)
+        assert status == 200 and payload["captions"]
+    finally:
+        server.shutdown()
