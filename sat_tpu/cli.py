@@ -92,6 +92,23 @@ def build_config(argv: Optional[List[str]] = None):
              "before the run, 'off' forces live decode",
     )
     p.add_argument(
+        "--verify_shards", default=None,
+        choices=["off", "sample", "open", "full"],
+        help="verify gathered shard rows against their per-row crc32c "
+             "sidecars (data/integrity.py): 'sample' scrubs one rotating "
+             "row every few gathers (≪1%% of a step), 'open' fully "
+             "verifies each shard on first touch, 'full' verifies every "
+             "row every batch; corrupt rows fall back to live decode and, "
+             "failing that, are quarantined (docs/DATA_PIPELINE.md)",
+    )
+    p.add_argument(
+        "--repair_shards", action="store_true",
+        help="rebuild only the shard files holding crc-mismatching or "
+             "quarantined rows by re-decoding their source images "
+             "(bitwise-identical to a clean rebuild), print a JSON "
+             "report, and exit — no accelerator needed",
+    )
+    p.add_argument(
         "--anomaly_policy", default=None,
         choices=["off", "warn", "skip", "rollback"],
         help="anomaly-sentinel response to NaN/Inf or spiking metrics at "
@@ -220,6 +237,8 @@ def build_config(argv: Optional[List[str]] = None):
         )
     if args.shard_cache is not None:
         config = config.replace(shard_cache=args.shard_cache)
+    if args.verify_shards is not None:
+        config = config.replace(verify_shards=args.verify_shards)
     if args.anomaly_policy is not None:
         config = config.replace(anomaly_policy=args.anomaly_policy)
     if args.keep_checkpoints is not None:
@@ -268,6 +287,7 @@ def build_config(argv: Optional[List[str]] = None):
         "print_config": args.print_config,
         "supervise": args.supervise,
         "max_restarts": args.max_restarts,
+        "repair_shards": args.repair_shards,
     }
     return config, cli
 
@@ -309,6 +329,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         import json
 
         print(json.dumps(config.to_dict(), indent=2, sort_keys=True))
+        return 0
+
+    if cli["repair_shards"]:
+        # jax-free maintenance mode: rot repair touches only the shard
+        # files and manifest (data/integrity.py)
+        import json
+
+        from .data.integrity import repair_shards
+
+        try:
+            report = repair_shards(config)
+        except FileNotFoundError:
+            print(
+                "sat_tpu: --repair_shards: no shard cache exists for this "
+                f"config (looked under {config.shard_cache_dir!r})",
+                file=sys.stderr,
+                flush=True,
+            )
+            return 2
+        print(json.dumps(report, indent=2, sort_keys=True))
         return 0
 
     if cli["supervise"]:
@@ -354,6 +394,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     from . import runtime
     from .resilience import CheckpointWriteError, SimulatedPreemption
     from .resilience import retry as _retry
+    from .resilience.quarantine import (
+        DATA_CORRUPTION_EXIT_CODE,
+        SystemicCorruption,
+    )
 
     # process-wide IO-retry knobs for every phase (train re-applies them,
     # but eval/test read shards and caption files through retry_io too)
@@ -381,6 +425,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             # with --load)
             print(f"sat_tpu: {e}", file=sys.stderr, flush=True)
             return 1
+        except SystemicCorruption as e:
+            # the quarantine ceiling tripped: the input data is rotten,
+            # not the process — a distinct exit code the supervisor
+            # refuses to restart (a rerun re-reads the same rot)
+            print(f"sat_tpu: FATAL: {e}", file=sys.stderr, flush=True)
+            return DATA_CORRUPTION_EXIT_CODE
         # graceful SIGTERM/SIGINT: train() drained and returned normally —
         # fall through to exit 0 so the supervisor relaunches into --load
     elif config.phase == "serve":

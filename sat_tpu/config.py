@@ -112,6 +112,19 @@ class Config:
     # restart backoff (jittered exponential, resilience.retry's policy)
     supervise_max_restarts: int = 3
     supervise_backoff_s: float = 1.0
+    # Data-plane integrity (data/integrity.py): verify gathered shard
+    # rows against their per-row crc32c sidecars.  'off' trusts storage;
+    # 'sample' scrubs one rotating row every few gathers (≪1% of a
+    # step — scripts/bench_integrity.py gates it); 'open' fully verifies
+    # each shard on first touch; 'full' verifies every row every batch.
+    verify_shards: str = "off"
+    # Quarantine ledger path ("" = <summary_dir>/quarantine.jsonl) and
+    # the systemic-corruption ceiling: when more than this fraction of
+    # rows seen has been quarantined (and at least 8 records are
+    # involved), abort with exit code 87 instead of training on mostly
+    # substituted data (resilience/quarantine.py).
+    quarantine_ledger: str = ""
+    quarantine_max_fraction: float = 0.5
 
     # ---- telemetry (docs/OBSERVABILITY.md; no reference equivalent) ----
     # Host-side span tracing + run-health heartbeat.  Off by default:
@@ -288,6 +301,7 @@ class Config:
             ("rng_impl", ("threefry2x32", "rbg", "unsafe_rbg")),
             ("ce_dtype", ("float32", "bfloat16")),
             ("shard_cache", ("auto", "on", "off")),
+            ("verify_shards", ("off", "sample", "open", "full")),
             ("anomaly_policy", ("off", "warn", "skip", "rollback")),
             ("diag_level", ("off", "basic", "full")),
         )
@@ -305,6 +319,11 @@ class Config:
         if self.heartbeat_interval < 0:
             raise ValueError(
                 f"Config.heartbeat_interval={self.heartbeat_interval}: must be >= 0"
+            )
+        if not 0 < self.quarantine_max_fraction <= 1:
+            raise ValueError(
+                f"Config.quarantine_max_fraction="
+                f"{self.quarantine_max_fraction}: must be in (0, 1]"
             )
         if self.telemetry_buffer <= 0:
             raise ValueError(

@@ -192,7 +192,7 @@ def prepare_train_data(config: Config) -> DataSet:
             ),
         )
     else:
-        data = np.load(config.temp_data_file, allow_pickle=True).item()
+        data = np.load(config.temp_data_file, allow_pickle=True).item()  # sync-ok: host npy dict
         word_idxs, masks = data["word_idxs"], data["masks"]
 
     # self-heal a partially populated image dir (reference dataset.py:156-158)

@@ -27,6 +27,8 @@ from typing import Iterable, Iterator, List, Optional, Sequence
 import numpy as np
 
 from .. import telemetry
+from ..resilience.faultinject import consume_caption_fault, consume_decode_fault
+from ..resilience.quarantine import QuarantineManager, SystemicCorruption
 
 # Spatial mean of the Caffe ILSVRC-2012 mean image (BGR npy channel order);
 # matches np.load('ilsvrc_2012_mean.npy').mean(1).mean(1) in the reference.
@@ -51,7 +53,7 @@ class ImageLoader:
                 "hardcodes ILSVRC_2012_MEAN (captioner.encode) — a custom "
                 "mean would be silently ignored; use raw=False with it"
             )
-        self.mean = ILSVRC_2012_MEAN if mean is None else np.asarray(mean, np.float32)
+        self.mean = ILSVRC_2012_MEAN if mean is None else np.asarray(mean, np.float32)  # sync-ok: host constant
         self.size = size
         self.raw = raw
 
@@ -72,6 +74,7 @@ class ImageLoader:
         live decode in either mode."""
         import cv2
 
+        consume_decode_fault(image_file)  # SAT_FI_BAD_IMAGE_EVERY
         image = cv2.imread(image_file)
         if image is None:
             raise FileNotFoundError(f"cannot decode image: {image_file}")
@@ -106,6 +109,26 @@ class ImageLoader:
         return np.stack([self.load_image(f) for f in image_files])
 
 
+class PrefetchDecodeError(RuntimeError):
+    """A prefetch worker failed to decode an image.  The bare codec
+    error surfaces on the consumer side at an unrelated later batch
+    with no clue WHICH record broke; this wrapper carries the file and
+    batch coordinates (the original error rides ``__cause__``)."""
+
+    def __init__(
+        self, image_file: str, batch_index: int, row: int,
+        cause: Optional[BaseException] = None,
+    ):
+        detail = f": {cause}" if cause is not None else ""
+        super().__init__(
+            f"cannot decode {image_file!r} "
+            f"(batch {batch_index}, row {row}){detail}"
+        )
+        self.image_file = image_file
+        self.batch_index = batch_index
+        self.row = row
+
+
 class PrefetchLoader:
     """Wraps a batch iterator; assembles image batches ahead of the
     consumer in a ring of ``prefetch_depth`` ready slots (a bounded queue
@@ -136,12 +159,19 @@ class PrefetchLoader:
         num_workers: int = 8,
         prefetch_depth: int = 2,
         shard_cache=None,
+        quarantine: Optional[QuarantineManager] = None,
     ):
         self.dataset = dataset
         self.loader = image_loader or ImageLoader()
         self.num_workers = num_workers
         self.prefetch_depth = max(1, prefetch_depth)
         self.shard_cache = shard_cache
+        # quarantine=None (default, and every direct construction in
+        # tests): failures raise, as they always did.  runtime wires a
+        # run-level QuarantineManager in, flipping the data plane to
+        # contain-and-substitute (resilience.quarantine)
+        self.quarantine = quarantine
+        self._pass = 0  # __iter__ count: caption quarantine coordinates
         if shard_cache is not None and shard_cache.image_size != self.loader.size:
             raise ValueError(
                 f"shard cache rows are {shard_cache.image_size}px but the "
@@ -149,21 +179,51 @@ class PrefetchLoader:
                 "opened for a different preprocessing"
             )
 
-    def _decode_batch(self, batch, pool: ThreadPoolExecutor):
+    def _decode_batch(
+        self, batch, pool: ThreadPoolExecutor, pass_idx: int = 0,
+        batch_idx: int = 0,
+    ):
         with telemetry.span("data/decode_batch"):
-            return self._decode_batch_inner(batch, pool)
+            return self._decode_batch_inner(batch, pool, pass_idx, batch_idx)
 
-    def _decode_batch_inner(self, batch, pool: ThreadPoolExecutor):
+    def _decode_batch_inner(
+        self, batch, pool: ThreadPoolExecutor, pass_idx: int = 0,
+        batch_idx: int = 0,
+    ):
         if isinstance(batch, tuple):
             files, word_idxs, masks = batch
             out = {
-                "word_idxs": np.asarray(word_idxs, np.int32),
-                "masks": np.asarray(masks, np.float32),
+                "word_idxs": np.asarray(word_idxs, np.int32),  # sync-ok: host numpy
+                "masks": np.asarray(masks, np.float32),  # sync-ok: host numpy
             }
         else:
             files, out = batch, {}
+        files = [str(f) for f in files]
+        q = self.quarantine
+        # (row, file, reason, exc, kind) — everything that must not be
+        # trained on as-is; filled by the replay pre-pass, the gather,
+        # the live decode, and the caption anomaly scan below
+        bad: List[tuple] = []
+        flagged: set = set()
+        if q is not None:
+            q.note_rows(len(files))
+            # replayed ledger: substitute known-bad files proactively,
+            # never re-attempting the decode — a file repaired since the
+            # original run must not change the replay (bitwise rule)
+            for i, f in enumerate(files):
+                if q.known_bad_file(f):
+                    bad.append((i, f, "replayed_ledger", None, "image"))
+                    flagged.add(i)
         if self.shard_cache is not None:
-            raw = self.shard_cache.gather(files, fallback=self.loader.load_raw)
+            gather_bad = None if q is None else []
+            raw = self.shard_cache.gather(
+                files, fallback=self.loader.load_raw, bad_rows=gather_bad
+            )
+            if gather_bad:
+                for i, f, reason, exc in gather_bad:
+                    if i not in flagged:
+                        bad.append((i, f, reason, exc, "image"))
+                        flagged.add(i)
             # the final float32−mean step runs batch-wise here; elementwise
             # it is the exact op the live path applies per image, so the
             # two paths stay bitwise-identical
@@ -172,11 +232,86 @@ class PrefetchLoader:
                 else raw.astype(np.float32) - self.loader.mean
             )
         else:
-            out["images"] = np.stack(
-                list(pool.map(self.loader.load_image, files))
-            )
+            S = self.loader.size
+            dtype = np.uint8 if self.loader.raw else np.float32
+            images = np.zeros((len(files), S, S, 3), dtype)
+            def _load_one(i):
+                if i in flagged:
+                    return i, None, None
+                try:
+                    return i, self.loader.load_image(files[i]), None
+                except Exception as e:
+                    return i, None, e
+            for i, img, exc in pool.map(_load_one, range(len(files))):
+                if img is not None:
+                    images[i] = img
+                elif exc is not None:
+                    if q is None:
+                        raise PrefetchDecodeError(
+                            files[i], batch_idx, i, exc
+                        ) from exc
+                    bad.append((i, files[i], "decode_failed", exc, "image"))
+                    flagged.add(i)
+            out["images"] = images
         out["files"] = list(files)
+        if "word_idxs" in out:
+            for i in range(len(files)):
+                if consume_caption_fault():  # SAT_FI_BAD_CAPTION_AT
+                    out["word_idxs"][i] = 0
+                    out["masks"][i] = 0.0
+            if q is not None:
+                masks = out["masks"]
+                cap = masks.shape[1] if masks.ndim == 2 else 0
+                for i in range(len(files)):
+                    if i in flagged:
+                        continue
+                    n_tok = float(masks[i].sum())  # sync-ok: host numpy
+                    if q.known_bad_pos(pass_idx, batch_idx, i):
+                        reason = "replayed_ledger"
+                    elif n_tok == 0:
+                        reason = "caption_all_oov"
+                    elif cap and n_tok >= cap:
+                        reason = "caption_overlength"
+                    else:
+                        continue
+                    bad.append((i, files[i], reason, None, "caption"))
+                    flagged.add(i)
+        if q is not None and bad:
+            self._quarantine_and_substitute(
+                out, bad, len(files), pass_idx, batch_idx
+            )
         return out
+
+    def _quarantine_and_substitute(
+        self, out, bad, n_rows, pass_idx, batch_idx
+    ):
+        """Ledger every newly bad row, then overwrite each bad row
+        wholesale with a deterministically chosen healthy row of the
+        same batch — geometry never changes, a replay with the same
+        ledger substitutes identically."""
+        q = self.quarantine
+        bad_set = {b[0] for b in bad}
+        healthy = [i for i in range(n_rows) if i not in bad_set]
+        for i, f, reason, exc, kind in sorted(bad, key=lambda b: b[0]):
+            pos = (pass_idx, batch_idx, i) if kind == "caption" else None
+            if reason != "replayed_ledger":
+                # may raise SystemicCorruption (the ceiling)
+                q.quarantine(f, reason, kind=kind, pos=pos, exc=exc)
+            if not healthy:
+                raise SystemicCorruption(
+                    f"every row of batch {batch_idx} is quarantined "
+                    f"(last: {f!r}, {reason}) — no healthy row to "
+                    "substitute; the input data is systemically corrupt"
+                )
+            key = (
+                f"image:{f}" if kind == "image"
+                else f"caption:{pass_idx}:{batch_idx}:{i}"
+            )
+            j = healthy[QuarantineManager.substitute_index(key, len(healthy))]
+            for k in ("images", "word_idxs", "masks"):
+                if k in out:
+                    out[k][i] = out[k][j]
+            out["files"][i] = out["files"][j]
 
     def __iter__(self) -> Iterator[dict]:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
@@ -184,11 +319,16 @@ class PrefetchLoader:
         stop = threading.Event()
         error: List[BaseException] = []
 
+        pass_idx = self._pass
+        self._pass += 1
+
         def producer():
             try:
                 with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
-                    for batch in self.dataset:
-                        item = self._decode_batch(batch, pool)
+                    for batch_idx, batch in enumerate(self.dataset):
+                        item = self._decode_batch(
+                            batch, pool, pass_idx, batch_idx
+                        )
                         # Bounded put that aborts if the consumer went away,
                         # so an abandoned iterator can't pin a thread.
                         while not stop.is_set():

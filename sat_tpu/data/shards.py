@@ -41,12 +41,14 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..resilience.faultinject import FaultPlan
 from ..resilience.retry import retry_io
 from .. import telemetry
+from .integrity import crc32c_rows, write_row_crcs
 from ..utils.fileio import atomic_write, read_text
 
 MANIFEST_NAME = "manifest.json"
@@ -106,6 +108,15 @@ class ShardCache:
         self._entries: Dict[str, List[int]] = manifest["entries"]
         self._shard_files: List[str] = [s["file"] for s in manifest["shards"]]
         self._mmaps: List[Optional[np.memmap]] = [None] * len(self._shard_files)
+        self.integrity = None  # see enable_integrity / data.integrity
+
+    def enable_integrity(self, mode: str) -> None:
+        """Arm per-row crc verification on gather (``--verify_shards``)."""
+        from .integrity import ShardIntegrity
+
+        self.integrity = (
+            None if mode in (None, "", "off") else ShardIntegrity(self, mode)
+        )
 
     # -- open/validate -----------------------------------------------------
 
@@ -186,41 +197,72 @@ class ShardCache:
         self,
         image_files: Sequence[str],
         fallback: Optional[Callable[[str], np.ndarray]] = None,
+        bad_rows: Optional[List[Tuple[int, str, str, Optional[BaseException]]]] = None,
     ) -> np.ndarray:
         """Assemble a uint8 [B, S, S, 3] batch for ``image_files``.
 
         Rows are grouped by shard and copied with ONE fancy-index read per
         shard per batch — no JPEG codec, no per-image allocation.  Files
-        absent from the manifest go through ``fallback(file) -> uint8 row``
-        (live decode); with no fallback a miss raises KeyError so a
-        mis-wired cache can't silently emit garbage.
+        absent from the manifest — and, when integrity verification is
+        armed (``enable_integrity``), rows failing their sidecar crc —
+        go through ``fallback(file) -> uint8 row`` (live decode).
+
+        ``bad_rows`` opts into containment: rows that could not be
+        assembled at all (no fallback, or the fallback itself failed)
+        are zero-filled and reported as ``(index, file, reason, exc)``
+        tuples for the caller to quarantine.  Without it, failures
+        raise (KeyError on a miss with no fallback, the decode error
+        otherwise) so a mis-wired cache can't silently emit garbage.
         """
         with telemetry.span("data/shard_gather"):
             S = self.image_size
             out = np.empty((len(image_files), S, S, 3), np.uint8)
             by_shard: Dict[int, List[int]] = {}
             rows: List[int] = [0] * len(image_files)
-            misses: List[int] = []
+            retry: List[Tuple[int, str]] = []
             for i, f in enumerate(image_files):
                 entry = self._entries.get(_key(f))
                 if entry is None:
-                    misses.append(i)
+                    retry.append((i, "cache_miss"))
                     continue
                 by_shard.setdefault(entry[0], []).append(i)
                 rows[i] = entry[1]
             for shard_idx, positions in by_shard.items():
                 mm = self._shard(shard_idx)
-                out[positions] = mm[[rows[i] for i in positions]]
-            if misses:
-                if fallback is None:
+                row_ids = [rows[i] for i in positions]
+                out[positions] = mm[row_ids]
+                if self.integrity is not None:
+                    for local in self.integrity.verify_gather(
+                        shard_idx, row_ids, out[positions]
+                    ):
+                        retry.append((positions[local], "crc_mismatch"))
+            if retry:
+                if fallback is None and bad_rows is None:
                     raise KeyError(
-                        f"{len(misses)} image(s) not in shard cache "
-                        f"{self.cache_dir} and no live-decode fallback given "
-                        f"(first: {image_files[misses[0]]!r})"
+                        f"{len(retry)} image(s) not in shard cache "
+                        f"{self.cache_dir} ({retry[0][1]}) and no "
+                        f"live-decode fallback given "
+                        f"(first: {image_files[retry[0][0]]!r})"
                     )
-                telemetry.count("data/decode_fallback", len(misses))
-                for i in misses:
-                    out[i] = fallback(str(image_files[i]))
+                fell_back = 0
+                for i, reason in retry:
+                    f = str(image_files[i])
+                    if fallback is None:
+                        bad_rows.append((i, f, reason, None))
+                        out[i] = 0
+                        continue
+                    try:
+                        out[i] = fallback(f)
+                        fell_back += 1
+                    except Exception as e:
+                        if bad_rows is None:
+                            raise
+                        bad_rows.append(
+                            (i, f, reason + "+live_decode_failed", e)
+                        )
+                        out[i] = 0
+                if fell_back:
+                    telemetry.count("data/decode_fallback", fell_back)
             return out
 
 
@@ -301,6 +343,14 @@ def build_shard_cache(
             raise
         del mm  # close before rename (flushes remaining dirty pages)
         os.replace(tmp, os.path.join(cache_dir, name))
+        # per-row crc32c sidecar, computed from the landed bytes so it
+        # attests what readers will actually mmap (data.integrity)
+        write_row_crcs(
+            os.path.join(cache_dir, name),
+            crc32c_rows(
+                np.asarray(np.load(os.path.join(cache_dir, name), mmap_mode="r"))  # sync-ok: host numpy
+            ),
+        )
         shards.append(
             {
                 "file": name,
@@ -390,6 +440,11 @@ def resolve_shard_cache(config, image_files: Sequence[str]):
             progress=True,
         )
     if cache is not None:
+        # fault point: rot a shard row AFTER build wrote the sidecars,
+        # so --verify_shards has something real to catch (idempotent —
+        # the train and eval loaders both resolve)
+        FaultPlan.from_env().maybe_corrupt_shard_row(cache_dir)
+        cache.enable_integrity(getattr(config, "verify_shards", "off"))
         uniq = {_key(f) for f in image_files}
         hits = sum(1 for k in uniq if k in cache._entries)
         print(

@@ -24,6 +24,44 @@ import numpy as np
 from .tokenizer import tokenize
 
 
+# vocab_fingerprint memo: keyed on (abspath, size, mtime, fsize) so the
+# common case — every checkpoint save of a run fingerprinting the same
+# unchanged CSV — parses it once.
+_FINGERPRINT_CACHE: Dict[tuple, Dict[str, object]] = {}
+
+
+def vocab_fingerprint(path: str, size: int) -> Dict[str, object] | None:
+    """Content identity of the EFFECTIVE vocabulary a run decodes with:
+    sha256 over the size-truncated word list plus its length.  Recorded
+    into the checkpoint lineage sidecar and compared at restore/serve
+    load, so a checkpoint trained against one vocabulary fails fast
+    against another instead of silently skipping the mismatched
+    embedding (see train.checkpoint._check_vocab).  None when the file
+    is missing or unreadable (nothing to attest)."""
+    import hashlib
+
+    try:
+        apath = os.path.abspath(path)
+        st = os.stat(apath)
+    except OSError:
+        return None
+    key = (apath, int(size), st.st_mtime_ns, st.st_size)
+    got = _FINGERPRINT_CACHE.get(key)
+    if got is None:
+        try:
+            vocab = Vocabulary(size, apath)
+        except Exception:
+            return None
+        got = {
+            "sha256": hashlib.sha256(
+                "\n".join(vocab.words).encode("utf-8")
+            ).hexdigest(),
+            "size": len(vocab.words),
+        }
+        _FINGERPRINT_CACHE[key] = got
+    return dict(got)
+
+
 class Vocabulary:
     def __init__(self, size: int, save_file: str | None = None):
         self.words: List[str] = []
@@ -112,7 +150,7 @@ class Vocabulary:
                 {
                     "word": list(self.words),
                     "index": list(range(self.size)),
-                    "frequency": list(np.asarray(self.word_frequencies)),
+                    "frequency": list(np.asarray(self.word_frequencies)),  # sync-ok: host numpy
                 }
             ).to_csv(f),
         )

@@ -16,7 +16,11 @@ Four recovery paths, each provable under deterministic fault injection
   crash-only ``--supervise`` restart loop (:mod:`.supervisor`), resuming
   from ``LAST_GOOD`` on whatever device topology is available now
   (the lineage sidecar records the topology the checkpoint was written
-  under).
+  under);
+* **poisoned input data** → the append-only quarantine ledger with
+  deterministic substitution and the systemic-corruption ceiling
+  (exit 87, never restarted — :mod:`.quarantine`), fed by the
+  per-record integrity checks in :mod:`sat_tpu.data.integrity`.
 
 Nothing here imports jax at module level; the injection harness
 (:mod:`.faultinject`) is inert unless ``SAT_FI_*`` env vars arm it.
@@ -44,6 +48,11 @@ from .lineage import (
     write_sidecar,
 )
 from .preempt import GracefulShutdown
+from .quarantine import (
+    DATA_CORRUPTION_EXIT_CODE,
+    QuarantineManager,
+    SystemicCorruption,
+)
 from .retry import backoff_delay, configure, is_retryable, retry_io
 from .sentinel import AnomalySentinel
 from .supervisor import supervise
@@ -52,10 +61,13 @@ from .watchdog import WATCHDOG_EXIT_CODE, Watchdog
 __all__ = [
     "AnomalySentinel",
     "CheckpointWriteError",
+    "DATA_CORRUPTION_EXIT_CODE",
     "FaultPlan",
     "GracefulShutdown",
     "InjectedIOError",
+    "QuarantineManager",
     "SimulatedPreemption",
+    "SystemicCorruption",
     "WATCHDOG_EXIT_CODE",
     "Watchdog",
     "apply_retention",

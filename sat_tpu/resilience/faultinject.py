@@ -45,6 +45,24 @@ Knobs::
                                batch at the result drain: its requests
                                must fail 500, /healthz must degrade to
                                503, and the engine re-warms
+    SAT_FI_CORRUPT_SHARD_ROW=k overwrite the first bytes of row k of
+                               shard-00000.npy when the shard cache is
+                               resolved (bit rot in a data shard; the
+                               crc sidecar must detect it and the
+                               live-decode fallback must recover).
+                               Idempotent constant write, so re-firing
+                               across loaders/restarts is harmless
+    SAT_FI_BAD_IMAGE_EVERY=n   the live decode of any image whose
+                               basename hashes into bucket 0 of n
+                               raises (a truncated/rotted JPEG
+                               population; quarantine must contain it).
+                               Keyed on the file NAME, not call order,
+                               so firing is deterministic under the
+                               decode thread pool
+    SAT_FI_BAD_CAPTION_AT=k    poison the k-th tokenized caption row
+                               (its word_idxs/masks zeroed) so the
+                               caption-anomaly detector must quarantine
+                               it
 """
 
 from __future__ import annotations
@@ -96,6 +114,9 @@ class FaultPlan:
     wedge_at_step: Optional[int] = None
     slow_step_ms: Optional[int] = None
     wedge_serve_batch: Optional[int] = None
+    corrupt_shard_row: Optional[int] = None
+    bad_image_every: Optional[int] = None
+    bad_caption_at: Optional[int] = None
     _fired: Dict[str, bool] = field(default_factory=dict)
 
     @classmethod
@@ -109,6 +130,9 @@ class FaultPlan:
             wedge_at_step=_env_int(env, "WEDGE_AT_STEP"),
             slow_step_ms=_env_int(env, "SLOW_STEP_MS"),
             wedge_serve_batch=_env_int(env, "WEDGE_SERVE_BATCH"),
+            corrupt_shard_row=_env_int(env, "CORRUPT_SHARD_ROW"),
+            bad_image_every=_env_int(env, "BAD_IMAGE_EVERY"),
+            bad_caption_at=_env_int(env, "BAD_CAPTION_AT"),
         )
 
     @property
@@ -121,6 +145,9 @@ class FaultPlan:
             and self.wedge_at_step is None
             and self.slow_step_ms is None
             and self.wedge_serve_batch is None
+            and self.corrupt_shard_row is None
+            and self.bad_image_every is None
+            and self.bad_caption_at is None
         )
 
     def _once(self, key: str) -> bool:
@@ -197,6 +224,26 @@ class FaultPlan:
             return
         corrupt_byte(path)
 
+    def maybe_corrupt_shard_row(self, cache_dir: str) -> None:
+        """When the shard cache is resolved: overwrite the first bytes
+        of row ``corrupt_shard_row`` of the first shard with a constant
+        (NOT a flip — a toggle would self-heal on the second loader's
+        resolve).  The crc sidecar, written at build time, goes stale
+        against exactly that row."""
+        if self.corrupt_shard_row is None:
+            return
+        path = os.path.join(cache_dir, "shard-00000.npy")
+        if not os.path.exists(path):
+            return
+        import numpy as np
+
+        mm = np.load(path, mmap_mode="r+")
+        row = min(self.corrupt_shard_row, len(mm) - 1)
+        flat = mm.reshape(len(mm), -1)
+        flat[row, :4] = 0xA5
+        mm.flush()
+        del mm
+
 
 def corrupt_byte(path: str, offset: Optional[int] = None) -> None:
     """Flip one byte of ``path`` in place (test helper + injection body).
@@ -235,6 +282,48 @@ def consume_io_fault(desc: str) -> None:
         raise InjectedIOError(desc, _io_state["remaining"])
 
 
+# -- bad-record injection (consumed by the data plane) ----------------------
+
+# Caption faults are counted in the (single) tokenizing producer thread,
+# so a plain counter is deterministic; keyed on the raw spec like
+# _io_state so re-arming resets it.
+_caption_state: Dict[str, Any] = {"spec": None, "count": 0}
+
+
+def consume_decode_fault(image_file: str) -> None:
+    """Called by ``ImageLoader.load_raw`` per image.  Inert (one env get)
+    unless ``SAT_FI_BAD_IMAGE_EVERY`` is set; then raises for the stable
+    1/n of images whose *basename* hashes into bucket 0 — call-order
+    independent (the decode pool is unordered) and identical across
+    runs/tmpdirs over the same file names."""
+    spec = os.environ.get(ENV_PREFIX + "BAD_IMAGE_EVERY")
+    if not spec:
+        return
+    import zlib
+
+    n = max(1, int(spec))
+    if zlib.crc32(os.path.basename(image_file).encode("utf-8")) % n == 0:
+        raise ValueError(
+            f"injected decode failure (SAT_FI_BAD_IMAGE_EVERY={n}): "
+            f"{image_file}"
+        )
+
+
+def consume_caption_fault() -> bool:
+    """Called per tokenized caption row.  True exactly once, when the
+    running row count passes ``SAT_FI_BAD_CAPTION_AT`` — the caller
+    zeroes that row so the anomaly detector has something to catch."""
+    spec = os.environ.get(ENV_PREFIX + "BAD_CAPTION_AT")
+    if not spec:
+        _caption_state["spec"] = None
+        return False
+    if _caption_state["spec"] != spec:
+        _caption_state.update(spec=spec, count=0)
+    _caption_state["count"] += 1
+    return _caption_state["count"] == int(spec)
+
+
 def reset_io_faults() -> None:
     """Forget injection bookkeeping (test isolation)."""
     _io_state.update(spec=None, remaining=0, match="")
+    _caption_state.update(spec=None, count=0)

@@ -97,42 +97,63 @@ def file_sha256(path: str) -> str:
     return h.hexdigest()
 
 
-def write_sidecar(ckpt_path: str, topology: Optional[dict] = None) -> str:
+def write_sidecar(
+    ckpt_path: str,
+    topology: Optional[dict] = None,
+    vocab: Optional[dict] = None,
+) -> str:
     """Hash the landed checkpoint and record it; the sidecar is what makes
     later verification a byte-for-byte statement instead of a guess.
 
     ``topology`` (optional) is the device topology the checkpoint was
     written under — ``{"device_count", "mesh_shape", "mesh_axes",
-    "platform"}`` — appended as a JSON line AFTER the digest line.
-    :func:`verify_checkpoint` reads only the first whitespace-delimited
-    token, so the extension is invisible to every existing sidecar
-    consumer; :func:`read_sidecar_topology` is the reader.  Elastic
-    resume (docs/RESILIENCE.md) uses it to report topology changes —
-    the saved state itself is always host-flat full arrays, so restoring
-    onto a different mesh is a re-placement, not a data transform."""
+    "platform"}``; ``vocab`` (optional) is the content identity of the
+    vocabulary the model was trained against — ``{"sha256", "size"}``
+    (data.vocabulary.vocab_fingerprint).  Both ride one JSON line
+    appended AFTER the digest line.  :func:`verify_checkpoint` reads
+    only the first whitespace-delimited token, so the extension is
+    invisible to every existing sidecar consumer;
+    :func:`read_sidecar_meta` is the reader.  Elastic resume
+    (docs/RESILIENCE.md) uses the topology to report changes — the
+    saved state itself is always host-flat full arrays, so restoring
+    onto a different mesh is a re-placement, not a data transform.  The
+    vocab record lets restore fail fast on a vocabulary swap instead of
+    silently skipping the mismatched embedding."""
     digest = retry_io(
         lambda: file_sha256(ckpt_path), desc=f"hash checkpoint {ckpt_path}"
     )
     lines = f"{digest}  {os.path.basename(ckpt_path)}\n"
+    meta = {}
     if topology:
-        lines += json.dumps({"topology": topology}, sort_keys=True) + "\n"
+        meta["topology"] = topology
+    if vocab:
+        meta["vocab"] = vocab
+    if meta:
+        lines += json.dumps(meta, sort_keys=True) + "\n"
     atomic_write(sidecar_path(ckpt_path), "w", lambda f: f.write(lines))
     return digest
 
 
-def read_sidecar_topology(ckpt_path: str) -> Optional[dict]:
-    """Topology record from ``ckpt_path``'s sidecar, or None when the
-    sidecar is missing or predates the topology extension."""
+def read_sidecar_meta(ckpt_path: str) -> dict:
+    """The JSON metadata record from ``ckpt_path``'s sidecar (topology,
+    vocab, ...), or {} when the sidecar is missing or predates the
+    extension."""
     sc = sidecar_path(ckpt_path)
     try:
         with open(sc) as f:
             for line in f.read().splitlines()[1:]:
                 line = line.strip()
                 if line.startswith("{"):
-                    return json.loads(line).get("topology")
+                    return json.loads(line)
     except (OSError, ValueError):
-        return None
-    return None
+        return {}
+    return {}
+
+
+def read_sidecar_topology(ckpt_path: str) -> Optional[dict]:
+    """Topology record from ``ckpt_path``'s sidecar, or None when the
+    sidecar is missing or predates the topology extension."""
+    return read_sidecar_meta(ckpt_path).get("topology")
 
 
 def verify_checkpoint(ckpt_path: str) -> Tuple[bool, str]:

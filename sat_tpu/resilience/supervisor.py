@@ -38,6 +38,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from .faultinject import ENV_PREFIX as _FI_PREFIX
+from .quarantine import DATA_CORRUPTION_EXIT_CODE
 from .retry import backoff_delay
 from .watchdog import WATCHDOG_EXIT_CODE
 
@@ -69,6 +70,8 @@ def _strip_supervise(argv: List[str]) -> List[str]:
 def _describe(rc: int) -> str:
     if rc == WATCHDOG_EXIT_CODE:
         return "watchdog abort (wedged run; LAST_GOOD landed)"
+    if rc == DATA_CORRUPTION_EXIT_CODE:
+        return "systemic data corruption (quarantine ceiling)"
     if rc < 0:
         try:
             return f"killed by {signal.Signals(-rc).name}"
@@ -152,6 +155,19 @@ def supervise(
                 print(
                     f"[supervise] child died ({_describe(rc)}) after the "
                     "supervisor was signaled — not restarting",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                return rc
+            if rc == DATA_CORRUPTION_EXIT_CODE:
+                # fatal, never restarted: the rot is in the INPUT data,
+                # so a relaunch deterministically re-reads it and trips
+                # the same ceiling — crash-only restarts only help when
+                # the failure is in the process plane
+                print(
+                    f"[supervise] child failed ({_describe(rc)}) — not "
+                    "restarting; repair the data (--repair_shards) or "
+                    "inspect the quarantine ledger",
                     file=sys.stderr,
                     flush=True,
                 )

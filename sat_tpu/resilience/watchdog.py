@@ -48,7 +48,8 @@ from .. import telemetry
 # Distinct from every exit code already in the fleet's vocabulary:
 # 0 clean, 1 checkpoint-write/preemption failure, 2 pytest/argparse,
 # 3 bench-child watchdog + check_regression infra-skip, 4 bench
-# orchestrator gave up.  The supervisor treats this one as "wedged,
+# orchestrator gave up, 87 systemic data corruption (the quarantine
+# ceiling — resilience/quarantine.py; the supervisor must NOT restart it).  The supervisor treats this one as "wedged,
 # state on disk is good, restart me".
 WATCHDOG_EXIT_CODE = 86
 

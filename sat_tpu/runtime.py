@@ -57,12 +57,35 @@ from .utils.summary import SummaryWriter
 # ---------------------------------------------------------------------------
 
 
+# One QuarantineManager per ledger path (i.e. per run): the systemic-
+# corruption ceiling is a run-level judgement, so the train and eval
+# loaders of one run must share the bookkeeping.
+_QUARANTINES: Dict[str, "object"] = {}
+
+
+def _quarantine_for(config: Config):
+    from .resilience.quarantine import QuarantineManager, ledger_path_for
+
+    path = ledger_path_for(config)
+    q = _QUARANTINES.get(path)
+    if q is None:
+        q = QuarantineManager(
+            path, max_fraction=config.quarantine_max_fraction
+        )
+        _QUARANTINES[path] = q
+    return q
+
+
 def make_loader(config: Config, dataset: DataSet) -> PrefetchLoader:
     """The host-side input pipeline for a dataset: shard-cache resolution
     (build-or-load per ``config.shard_cache``; falls back to live JPEG
     decode when no valid cache exists — see data.shards) + the prefetching
-    batch assembler.  All three phase loops build their feed here so the
-    cache policy is applied uniformly."""
+    batch assembler, with the run's quarantine wired in (bad records are
+    contained and substituted instead of crashing the run — see
+    resilience.quarantine; direct PrefetchLoader construction without a
+    quarantine keeps the old raise-through behavior).  All three phase
+    loops build their feed here so the cache policy is applied
+    uniformly."""
     from .data.shards import resolve_shard_cache
 
     return PrefetchLoader(
@@ -71,6 +94,7 @@ def make_loader(config: Config, dataset: DataSet) -> PrefetchLoader:
         num_workers=config.num_data_workers,
         prefetch_depth=config.prefetch_depth,
         shard_cache=resolve_shard_cache(config, dataset.image_files),
+        quarantine=_quarantine_for(config),
     )
 
 
@@ -149,8 +173,17 @@ def setup_state(
             # name-translation path so reference-trained models run here
             state, count = import_reference_checkpoint(state, model_file)
         else:
+            from .data.vocabulary import vocab_fingerprint
+
             state, count = restore_checkpoint(
-                state, model_file=model_file, save_dir=config.save_dir
+                state,
+                model_file=model_file,
+                save_dir=config.save_dir,
+                # fail fast on a vocabulary swap instead of silently
+                # skipping the mismatched embedding (partial restore)
+                expect_vocab=vocab_fingerprint(
+                    config.vocabulary_file, config.vocabulary_size
+                ),
             )
         if count == 0:
             raise ValueError(

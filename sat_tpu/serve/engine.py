@@ -55,17 +55,29 @@ def load_serving_state(config: Config, model_file: Optional[str] = None):
     """
     import jax
 
+    from ..data.vocabulary import vocab_fingerprint
+
     state = create_train_state(jax.random.PRNGKey(config.seed), config)
+    # serving decodes against the configured vocabulary: a checkpoint
+    # attesting a different one must fail here, loudly, not caption in
+    # gibberish (train.checkpoint.VocabMismatchError)
+    expect = vocab_fingerprint(config.vocabulary_file, config.vocabulary_size)
     if model_file:
         source = model_file
-        state, count = restore_checkpoint(state, model_file=model_file)
+        state, count = restore_checkpoint(
+            state, model_file=model_file, expect_vocab=expect
+        )
     else:
         source = lineage.last_good_checkpoint(config.save_dir)
         if source is not None:
-            state, count = restore_checkpoint(state, model_file=source)
+            state, count = restore_checkpoint(
+                state, model_file=source, expect_vocab=expect
+            )
         else:
             source = config.save_dir
-            state, count = restore_checkpoint(state, save_dir=config.save_dir)
+            state, count = restore_checkpoint(
+                state, save_dir=config.save_dir, expect_vocab=expect
+            )
     if count == 0:
         raise ValueError(f"serving checkpoint {source} restored 0 tensors")
     return state, source

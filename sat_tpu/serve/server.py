@@ -184,7 +184,13 @@ class CaptionServer:
             with self._tel.span("serve/preprocess"):
                 image = self.engine.preprocess(body)
         except Exception as e:
-            return 400, {"error": f"bad image: {e}"}
+            # undecodable POST body: a client problem, not a server crash —
+            # counted so a flood of garbage uploads shows in the heartbeat
+            self._tel.count("serve/bad_input")
+            return 400, {
+                "error": "bad image",
+                "detail": f"cannot decode image bytes: {e}",
+            }
         if deadline_ms is None or deadline_ms == "":
             budget_ms = self.config.serve_deadline_ms
         else:

@@ -146,6 +146,16 @@ class Heartbeat:
         }
         if sup:
             payload["supervisor"] = sup
+        # data-plane health (data.integrity / resilience.quarantine):
+        # quarantined totals + fraction, prefetch depth — a watcher sees
+        # input corruption being contained while the run keeps training
+        data = {
+            k[len("data/"):]: v
+            for k, v in gauges.items()
+            if k.startswith("data/")
+        }
+        if data:
+            payload["data"] = data
         if self._sampler is not None:
             try:
                 payload.update(self._sampler() or {})

@@ -295,7 +295,17 @@ def _write_flat(
     # sidecar computed later would faithfully fingerprint whatever rot
     # happened in between and the verify would bless corrupt bytes
     with telemetry.span("ckpt/sidecar"):
-        lineage.write_sidecar(path, topology=_topology_snapshot(config))
+        try:
+            from ..data.vocabulary import vocab_fingerprint
+
+            vocab = vocab_fingerprint(
+                config.vocabulary_file, config.vocabulary_size
+            )
+        except Exception:
+            vocab = None  # attestation is best-effort; the save is not
+        lineage.write_sidecar(
+            path, topology=_topology_snapshot(config), vocab=vocab
+        )
     retry_io(
         lambda: config.replace(global_step=step).save(
             os.path.join(save_dir, "config.json")
@@ -406,8 +416,41 @@ def _note_elastic_restore(path: str) -> None:
         )
 
 
+class VocabMismatchError(RuntimeError):
+    """The checkpoint's lineage sidecar attests a different vocabulary
+    than the one this run is configured with.  Without this check the
+    word-embedding rows would be silently skipped by the shape-tolerant
+    partial restore and the model would decode gibberish."""
+
+
+def _check_vocab(path: str, expect: Optional[dict]) -> None:
+    """Compare the run's vocabulary fingerprint against the sidecar's.
+    Both sides optional: a legacy sidecar (no vocab record) or a run
+    that could not fingerprint its vocabulary checks nothing."""
+    if not expect:
+        return
+    recorded = lineage.read_sidecar_meta(path).get("vocab")
+    if not recorded:
+        return
+    if (
+        recorded.get("sha256") != expect.get("sha256")
+        or int(recorded.get("size", 0)) != int(expect.get("size", 0))
+    ):
+        raise VocabMismatchError(
+            f"vocab mismatch (got {expect.get('size')} words, sha "
+            f"{str(expect.get('sha256'))[:12]}…; checkpoint "
+            f"{os.path.basename(path)} expects {recorded.get('size')} "
+            f"words, sha {str(recorded.get('sha256'))[:12]}…) — the "
+            "vocabulary file changed since this checkpoint was trained; "
+            "restore with the original vocabulary.csv or retrain"
+        )
+
+
 def restore_checkpoint(
-    state: Any, model_file: Optional[str] = None, save_dir: Optional[str] = None
+    state: Any,
+    model_file: Optional[str] = None,
+    save_dir: Optional[str] = None,
+    expect_vocab: Optional[dict] = None,
 ) -> Tuple[Any, int]:
     """Restore into an existing state skeleton.
 
@@ -416,6 +459,13 @@ def restore_checkpoint(
     shape-mismatched entries are skipped (partial restore), so trimmed
     inference checkpoints load cleanly into a full train state.
     Returns (new_state, tensors_loaded).
+
+    ``expect_vocab`` (``data.vocabulary.vocab_fingerprint`` of the
+    run's configured vocabulary) is compared against the candidate's
+    lineage sidecar; a mismatch raises :class:`VocabMismatchError`
+    IMMEDIATELY — it is a configuration error, not file rot, so the
+    save_dir mode does NOT walk back past it (every older checkpoint of
+    the run was trained against the same vocabulary).
 
     In ``save_dir`` mode a torn / corrupt / unreadable newest checkpoint
     is not fatal: each candidate is integrity-checked
@@ -426,6 +476,7 @@ def restore_checkpoint(
     propagate.
     """
     if model_file:
+        _check_vocab(model_file, expect_vocab)
         flat = load_flat(model_file)
         _note_elastic_restore(model_file)
     else:
@@ -438,6 +489,7 @@ def restore_checkpoint(
             ok, reason = lineage.verify_checkpoint(path)
             if ok:
                 try:
+                    _check_vocab(path, expect_vocab)
                     flat = load_flat(path)
                     _note_elastic_restore(path)
                     break

@@ -1,0 +1,559 @@
+"""Chaos campaign: every SAT_FI fault schedule through real supervised runs.
+
+tests/test_resilience.py and tests/test_supervisor.py pin each recovery
+path one fault at a time; this harness is the fleet-shaped rehearsal —
+the FULL fault matrix (docs/RESILIENCE.md), each scenario a short real
+training run on the synthetic COCO fixture, asserting the documented
+invariant for that failure mode:
+
+* exit codes land where the contract says (0 contained / recovered,
+  86 watchdog abort inside a supervised pair, 87 systemic data
+  corruption — and 87 is terminal: the supervisor must NOT restart it);
+* contained data faults leave a non-empty quarantine ledger, surface
+  ``data/quarantined*`` gauges in heartbeat.json, and NEVER change batch
+  geometry — a replay against the same ledger reproduces the final
+  checkpoint bitwise;
+* process-plane faults (preempt/wedge/SIGTERM/ckpt rot/IO flake) resume
+  or degrade exactly as their tests promise, end-to-end through the CLI.
+
+Emits a campaign report: a JSON array of BENCH-contract rows
+({"metric": "chaos_<scenario>", "value": 1.0|0.0, ...}) plus a
+``chaos_pass_rate`` summary, stamped with ``schema_version`` so
+``scripts/check_regression.py`` accepts the artifact as-is.
+
+Runs on CPU (JAX_PLATFORMS=cpu), sharing the test suite's persistent XLA
+compile cache, so the whole matrix is minutes, not hours.
+
+Usage: python scripts/chaos_campaign.py [--list] [--only a,b,...]
+       [--out report.json] [--workdir DIR] [--keep] [--timeout 420]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from sat_tpu import telemetry
+from sat_tpu.resilience import lineage
+from sat_tpu.resilience.quarantine import DATA_CORRUPTION_EXIT_CODE
+from sat_tpu.resilience.watchdog import WATCHDOG_EXIT_CODE
+
+_T0 = time.perf_counter()
+
+
+def log(msg: str) -> None:
+    print(f"[chaos_campaign +{time.perf_counter() - _T0:6.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+# Same tiny model the resilience tests train: 24 annotation rows, batch 4
+# -> 6 steps, checkpoints at 3 and 6.  Telemetry on so every scenario can
+# read heartbeat.json.
+SMALL_MODEL = dict(
+    image_size=32,
+    dim_embedding=16,
+    num_lstm_units=16,
+    dim_initialize_layer=16,
+    dim_attend_layer=16,
+    dim_decode_layer=32,
+    compute_dtype="float32",
+    save_period=3,
+    log_every=1,
+    num_epochs=1,
+    num_data_workers=2,
+    telemetry=True,
+    heartbeat_interval=0.1,
+)
+
+# Watchdog/supervisor timings for the scenarios that arm them (the
+# test_supervisor chaos values: fast enough to fire inside one run).
+CHAOS_TIMINGS = dict(
+    watchdog_interval=0.2,
+    watchdog_step_s=5.0,
+    watchdog_data_wait_s=120.0,
+    watchdog_dispatch_s=120.0,
+    watchdog_checkpoint_s=120.0,
+    watchdog_grace_s=0.3,
+    supervise_backoff_s=0.1,
+)
+
+
+class Failure(AssertionError):
+    """One scenario invariant did not hold."""
+
+
+def check(cond, msg: str) -> None:
+    if not cond:
+        raise Failure(msg)
+
+
+# -- child-run plumbing (mirrors tests/test_supervisor.py) ------------------
+
+
+def _child_env(extra=None):
+    from sat_tpu.utils.compile_cache import cache_dir
+
+    env = {k: v for k, v in os.environ.items() if not k.startswith("SAT_FI_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    env["JAX_COMPILATION_CACHE_DIR"] = cache_dir(".jax_cache")
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
+    env["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"
+    env["SAT_DEVICE_WATCHDOG_S"] = "0"
+    env.update(extra or {})
+    return env
+
+
+_TIMEOUT = 420
+
+
+def run_cli(args, env_extra=None):
+    return subprocess.run(
+        [sys.executable, "-m", "sat_tpu.cli", *args],
+        capture_output=True, text=True, cwd=REPO,
+        env=_child_env(env_extra), timeout=_TIMEOUT,
+    )
+
+
+class Ctx:
+    """One campaign's shared fixture + per-scenario config factory."""
+
+    def __init__(self, root: str):
+        from tests.fixtures import make_coco_fixture
+
+        self.root = root
+        fixture_dir = os.path.join(root, "fixture")
+        os.makedirs(fixture_dir, exist_ok=True)
+        self.fix = make_coco_fixture(fixture_dir)
+
+    def cfg(self, name: str, **kw):
+        base = os.path.join(self.root, name)
+        return self.fix["config"].replace(**{
+            **SMALL_MODEL,
+            "save_dir": os.path.join(base, "models"),
+            "summary_dir": os.path.join(base, "summary"),
+            **kw,
+        })
+
+    def launch(self, config, *extra_args, env=None, name: str = "run"):
+        path = os.path.join(self.root, f"{name}.json")
+        config.save(path)
+        return run_cli(["--config", path, *extra_args], env_extra=env)
+
+
+def _read_ledger(path):
+    if not os.path.exists(path):
+        return []
+    entries = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entries.append(json.loads(line))
+            except ValueError:
+                pass  # torn tail line: same tolerance as the manager
+    return entries
+
+
+def _heartbeat(config):
+    path = os.path.join(config.summary_dir, "telemetry", "heartbeat.json")
+    check(os.path.isfile(path), f"heartbeat.json missing: {path}")
+    with open(path) as f:
+        return json.load(f)
+
+
+def _flat_npz(path):
+    import numpy as np
+
+    with np.load(path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _assert_bitwise(path_a: str, path_b: str) -> None:
+    import numpy as np
+
+    a, b = _flat_npz(path_a), _flat_npz(path_b)
+    check(set(a) == set(b),
+          f"checkpoint key sets differ: {path_a} vs {path_b}")
+    for k in a:
+        check(np.array_equal(a[k], b[k]),
+              f"tensor {k} differs between {path_a} and {path_b}")
+
+
+def _final_ckpt(config, step: int = 6) -> str:
+    path = os.path.join(config.save_dir, f"{step}.npz")
+    check(os.path.isfile(path), f"expected final checkpoint {path}")
+    return path
+
+
+def _check_clean(proc, what: str) -> None:
+    check(proc.returncode == 0,
+          f"{what}: rc {proc.returncode}\n{proc.stdout}\n{proc.stderr}")
+
+
+# -- the scenario matrix ----------------------------------------------------
+
+SCENARIOS = []
+
+
+def scenario(fn):
+    SCENARIOS.append(fn)
+    return fn
+
+
+@scenario
+def control(ctx: Ctx):
+    """No faults: clean run, empty ledger, heartbeat alive."""
+    cfg = ctx.cfg("control")
+    proc = ctx.launch(cfg, name="control")
+    _check_clean(proc, "control run")
+    _final_ckpt(cfg)
+    check(not _read_ledger(os.path.join(cfg.summary_dir, "quarantine.jsonl")),
+          "control run quarantined records")
+    hb = _heartbeat(cfg)
+    check(hb.get("step") == 6, f"heartbeat step {hb.get('step')} != 6")
+    return {"steps": hb.get("step")}
+
+
+@scenario
+def preempt_restart(ctx: Ctx):
+    """SAT_FI_DIE_AT_STEP under --supervise: abrupt death, restart from
+    LAST_GOOD, clean completion."""
+    cfg = ctx.cfg("preempt", **CHAOS_TIMINGS)
+    proc = ctx.launch(cfg, "--supervise", env={"SAT_FI_DIE_AT_STEP": "5"},
+                      name="preempt")
+    _check_clean(proc, "supervised preempted run")
+    check("restarting from LAST_GOOD" in proc.stderr,
+          "supervisor never restarted")
+    _final_ckpt(cfg)
+    check(lineage.last_good_step(cfg.save_dir) == 6, "LAST_GOOD != 6")
+    return {"restarts": proc.stderr.count("restarting from LAST_GOOD")}
+
+
+@scenario
+def sigterm_drain(ctx: Ctx):
+    """SAT_FI_SIGTERM_AT_STEP: graceful boundary stop, final checkpoint
+    flushed and blessed, rc 0."""
+    cfg = ctx.cfg("sigterm")
+    proc = ctx.launch(cfg, env={"SAT_FI_SIGTERM_AT_STEP": "4"},
+                      name="sigterm")
+    _check_clean(proc, "SIGTERM run")
+    check("relaunch with --load" in proc.stderr, "no graceful-stop notice")
+    check(lineage.last_good_step(cfg.save_dir) == 4,
+          "boundary checkpoint not blessed")
+    return {"stopped_at": 4}
+
+
+@scenario
+def nan_sentinel_skip(ctx: Ctx):
+    """SAT_FI_NAN_AT_STEP with policy=skip: the poisoned tail never
+    reaches disk; the run still exits 0."""
+    cfg = ctx.cfg("nan_skip", anomaly_policy="skip")
+    proc = ctx.launch(cfg, env={"SAT_FI_NAN_AT_STEP": "4"}, name="nan_skip")
+    _check_clean(proc, "NaN-skip run")
+    check("final checkpoint suppressed" in proc.stderr,
+          "sentinel never suppressed the poisoned save")
+    check(lineage.checkpoint_steps(cfg.save_dir) == [3],
+          f"poisoned checkpoints on disk: "
+          f"{lineage.checkpoint_steps(cfg.save_dir)}")
+    return {"surviving_steps": [3]}
+
+
+@scenario
+def ckpt_bitrot(ctx: Ctx):
+    """SAT_FI_CORRUPT_CKPT_STEP: post-write verify catches the flip,
+    LAST_GOOD skips the rotten file, the run completes."""
+    cfg = ctx.cfg("ckpt_rot")
+    proc = ctx.launch(cfg, env={"SAT_FI_CORRUPT_CKPT_STEP": "3"},
+                      name="ckpt_rot")
+    _check_clean(proc, "checkpoint-rot run")
+    ok, _ = lineage.verify_checkpoint(os.path.join(cfg.save_dir, "3.npz"))
+    check(not ok, "corrupted 3.npz still verifies")
+    check(lineage.last_good_step(cfg.save_dir) == 6,
+          "LAST_GOOD did not advance past the rot")
+    return {"rotten_step": 3}
+
+
+@scenario
+def io_flake(ctx: Ctx):
+    """SAT_FI_IO_FAILURES: transient IO errors are retried through;
+    the run neither crashes nor loses a checkpoint."""
+    cfg = ctx.cfg("io_flake")
+    proc = ctx.launch(cfg, env={"SAT_FI_IO_FAILURES": "2"}, name="io_flake")
+    _check_clean(proc, "IO-flake run")
+    _final_ckpt(cfg)
+    check(lineage.last_good_step(cfg.save_dir) == 6, "LAST_GOOD != 6")
+    return {}
+
+
+@scenario
+def wedge_watchdog(ctx: Ctx):
+    """SAT_FI_WEDGE_AT_STEP under --supervise: watchdog aborts 86, the
+    supervisor restarts, the pair exits 0."""
+    cfg = ctx.cfg("wedge", **CHAOS_TIMINGS)
+    proc = ctx.launch(cfg, "--supervise", env={"SAT_FI_WEDGE_AT_STEP": "5"},
+                      name="wedge")
+    _check_clean(proc, "supervised wedged run")
+    check(f"aborting with exit code {WATCHDOG_EXIT_CODE}" in proc.stderr,
+          "watchdog never aborted")
+    check("restarting from LAST_GOOD" in proc.stderr,
+          "supervisor never restarted after 86")
+    _final_ckpt(cfg)
+    return {}
+
+
+@scenario
+def slow_step_quiet(ctx: Ctx):
+    """SAT_FI_SLOW_STEP_MS: degraded-but-alive must NOT trip the armed
+    watchdog."""
+    cfg = ctx.cfg("slow", **CHAOS_TIMINGS)
+    proc = ctx.launch(cfg, env={"SAT_FI_SLOW_STEP_MS": "50"}, name="slow")
+    _check_clean(proc, "slow-step run")
+    check("exceeded its" not in proc.stderr,
+          "watchdog fired on a slow-but-progressing run")
+    return {}
+
+
+@scenario
+def shard_bitrot_fallback(ctx: Ctx):
+    """SAT_FI_CORRUPT_SHARD_ROW with verify_shards=open: the crc sidecar
+    catches the rot, the row live-decodes through the fallback, nothing
+    is quarantined, and the final params match the clean run bitwise."""
+    cache_dir = os.path.join(ctx.root, "bitrot_cache")
+    common = dict(shard_cache="on", shard_cache_dir=cache_dir,
+                  verify_shards="open")
+    seed_cfg = ctx.cfg("bitrot_seed", **common)
+    _check_clean(ctx.launch(seed_cfg, name="bitrot_seed"),
+                 "cache-seeding run")
+
+    cfg = ctx.cfg("bitrot", **common)
+    proc = ctx.launch(cfg, env={"SAT_FI_CORRUPT_SHARD_ROW": "1"},
+                      name="bitrot")
+    _check_clean(proc, "shard-bitrot run")
+    check(not _read_ledger(os.path.join(cfg.summary_dir, "quarantine.jsonl")),
+          "recoverable bitrot was quarantined")
+    hb = _heartbeat(cfg)
+    counters = hb.get("counters", {})
+    check(counters.get("data/corrupt_rows", 0) >= 1,
+          f"corrupt row never detected: {counters}")
+    check(counters.get("data/decode_fallback", 0) >= 1,
+          f"fallback never decoded: {counters}")
+    _assert_bitwise(_final_ckpt(seed_cfg), _final_ckpt(cfg))
+    return {"corrupt_rows": counters.get("data/corrupt_rows")}
+
+
+@scenario
+def poison_quarantine_replay(ctx: Ctx):
+    """The acceptance e2e: CORRUPT_SHARD_ROW + BAD_IMAGE_EVERY armed —
+    the corrupt row's fallback decode also fails, the record is
+    quarantined and substituted, the run completes with zero crashes,
+    heartbeat carries the data gauges, and a replay against the same
+    ledger (faults disarmed) reproduces the final checkpoint bitwise."""
+    cache_dir = os.path.join(ctx.root, "poison_cache")
+    ledger = os.path.join(ctx.root, "poison_ledger.jsonl")
+    common = dict(shard_cache="on", shard_cache_dir=cache_dir,
+                  verify_shards="open", quarantine_ledger=ledger)
+    _check_clean(ctx.launch(ctx.cfg("poison_seed", shard_cache="on",
+                                    shard_cache_dir=cache_dir),
+                            name="poison_seed"),
+                 "cache-seeding run")
+
+    cfg = ctx.cfg("poison", **common)
+    proc = ctx.launch(
+        cfg,
+        env={"SAT_FI_CORRUPT_SHARD_ROW": "1", "SAT_FI_BAD_IMAGE_EVERY": "1"},
+        name="poison",
+    )
+    _check_clean(proc, "poisoned run")
+    entries = _read_ledger(ledger)
+    check(entries, "quarantine ledger is empty")
+    check(any("live_decode_failed" in e.get("reason", "") for e in entries),
+          f"no fallback-failure entry in ledger: {entries}")
+    hb = _heartbeat(cfg)
+    data = hb.get("data", {})
+    check(data.get("quarantined_total", 0) >= 1,
+          f"heartbeat data gauges missing: {hb.get('data')}")
+    check(hb.get("counters", {}).get("data/quarantined", 0) >= 1,
+          "data/quarantined counter missing")
+
+    replay_cfg = ctx.cfg("poison_replay", **common)
+    _check_clean(ctx.launch(replay_cfg, name="poison_replay"),
+                 "ledger replay run")
+    _assert_bitwise(_final_ckpt(cfg), _final_ckpt(replay_cfg))
+    return {"ledger_entries": len(entries)}
+
+
+@scenario
+def caption_anomaly(ctx: Ctx):
+    """SAT_FI_BAD_CAPTION_AT: an all-OOV caption row is quarantined by
+    position and substituted; the run completes."""
+    cfg = ctx.cfg("caption")
+    proc = ctx.launch(cfg, env={"SAT_FI_BAD_CAPTION_AT": "5"},
+                      name="caption")
+    _check_clean(proc, "bad-caption run")
+    entries = _read_ledger(os.path.join(cfg.summary_dir, "quarantine.jsonl"))
+    caption = [e for e in entries if e.get("kind") == "caption"]
+    check(caption, f"no caption-kind ledger entry: {entries}")
+    check(caption[0].get("reason") == "caption_all_oov",
+          f"unexpected reason: {caption[0]}")
+    _final_ckpt(cfg)
+    return {"ledger_entries": len(entries)}
+
+
+@scenario
+def systemic_no_restart(ctx: Ctx):
+    """SAT_FI_BAD_IMAGE_EVERY=1 (every record poisoned): the run must
+    abort with exit code 87 and the supervisor must NOT restart it."""
+    cfg = ctx.cfg("systemic", **CHAOS_TIMINGS, shard_cache="off")
+    proc = ctx.launch(cfg, "--supervise",
+                      env={"SAT_FI_BAD_IMAGE_EVERY": "1"}, name="systemic")
+    check(proc.returncode == DATA_CORRUPTION_EXIT_CODE,
+          f"rc {proc.returncode} != {DATA_CORRUPTION_EXIT_CODE}\n"
+          f"{proc.stdout}\n{proc.stderr}")
+    check("FATAL" in proc.stderr, "no FATAL notice")
+    check("not restarting" in proc.stderr,
+          "supervisor restarted a systemically corrupt run")
+    check("restarting from LAST_GOOD" not in proc.stderr,
+          "supervisor restarted a systemically corrupt run")
+    entries = _read_ledger(os.path.join(cfg.summary_dir, "quarantine.jsonl"))
+    check(entries, "systemic abort left no ledger")
+    return {"ledger_entries": len(entries)}
+
+
+@scenario
+def quarantine_ceiling(ctx: Ctx):
+    """The ledger is cumulative evidence: a run inheriting a ledger that
+    already names 8 rotten files needs ONE more quarantine to cross the
+    ceiling (fraction tightened to 0.1) and abort with exit 87."""
+    ledger = os.path.join(ctx.root, "ceiling_ledger.jsonl")
+    with open(ledger, "w") as f:
+        for i in range(8):
+            f.write(json.dumps({
+                "file": f"/decommissioned/rotten_{i}.jpg",
+                "reason": "decode_failed", "kind": "image", "sha": None,
+            }) + "\n")
+    cfg = ctx.cfg("ceiling", shard_cache="off", quarantine_ledger=ledger,
+                  quarantine_max_fraction=0.1)
+    # BAD_IMAGE_EVERY=6 poisons exactly one fixture basename: its first
+    # decode is quarantine #9 — past min_records, 9/rows_seen > 0.1
+    proc = ctx.launch(cfg, env={"SAT_FI_BAD_IMAGE_EVERY": "6"},
+                      name="ceiling")
+    check(proc.returncode == DATA_CORRUPTION_EXIT_CODE,
+          f"rc {proc.returncode} != {DATA_CORRUPTION_EXIT_CODE}\n"
+          f"{proc.stdout}\n{proc.stderr}")
+    check("systemic data corruption" in proc.stderr,
+          "abort did not name the ceiling")
+    check(len(_read_ledger(ledger)) == 9, "new quarantine never appended")
+    return {}
+
+
+# -- orchestration ----------------------------------------------------------
+
+
+def main() -> int:
+    global _TIMEOUT
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--list", action="store_true",
+                    help="print scenario names and exit")
+    ap.add_argument("--only", default="",
+                    help="comma-separated scenario subset")
+    ap.add_argument("--out", default="",
+                    help="write the campaign-report JSON array here too")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the workdir for inspection")
+    ap.add_argument("--timeout", type=int, default=420,
+                    help="per-child-run timeout, seconds")
+    args = ap.parse_args()
+    _TIMEOUT = args.timeout
+
+    if args.list:
+        for fn in SCENARIOS:
+            print(f"{fn.__name__}: {' '.join(fn.__doc__.split())}")
+        return 0
+
+    selected = SCENARIOS
+    if args.only:
+        want = {s.strip() for s in args.only.split(",") if s.strip()}
+        unknown = want - {fn.__name__ for fn in SCENARIOS}
+        if unknown:
+            print(f"unknown scenario(s): {sorted(unknown)}", file=sys.stderr)
+            return 1
+        selected = [fn for fn in SCENARIOS if fn.__name__ in want]
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_campaign_")
+    made_workdir = args.workdir is None
+    log(f"campaign of {len(selected)} scenario(s) under {workdir}")
+    rows, failed = [], []
+    try:
+        ctx = Ctx(workdir)
+        for fn in selected:
+            t0 = time.perf_counter()
+            try:
+                extras = fn(ctx) or {}
+                ok = True
+                detail = "ok"
+            except Failure as e:
+                ok, extras, detail = False, {}, str(e)
+            except subprocess.TimeoutExpired as e:
+                ok, extras = False, {}
+                detail = f"child run timed out after {e.timeout}s"
+            dt = time.perf_counter() - t0
+            status = "PASS" if ok else "FAIL"
+            log(f"{status} {fn.__name__} ({dt:.1f}s)"
+                + ("" if ok else f" — {detail.splitlines()[0]}"))
+            if not ok:
+                failed.append(fn.__name__)
+                print(f"--- {fn.__name__} failure detail ---\n{detail}",
+                      file=sys.stderr, flush=True)
+            rows.append({
+                "metric": f"chaos_{fn.__name__}",
+                "value": 1.0 if ok else 0.0,
+                "unit": "pass",
+                "vs_baseline": 1.0,
+                "seconds": round(dt, 1),
+                **extras,
+                **telemetry.bench_stamp(),
+            })
+        rows.append({
+            "metric": "chaos_pass_rate",
+            "value": round(1.0 - len(failed) / max(1, len(selected)), 4),
+            "unit": "fraction",
+            "vs_baseline": 1.0,
+            "scenarios": len(selected),
+            "failed": failed,
+            **telemetry.bench_stamp(),
+        })
+        report = json.dumps(rows, indent=1)
+        print(report, flush=True)
+        if args.out:
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                f.write(report + "\n")
+            log(f"report written to {args.out}")
+        if failed:
+            log(f"{len(failed)}/{len(selected)} scenario(s) FAILED: "
+                + ", ".join(failed))
+            return 1
+        log(f"all {len(selected)} scenario(s) passed")
+        return 0
+    finally:
+        if made_workdir and not args.keep:
+            shutil.rmtree(workdir, ignore_errors=True)
+        elif args.keep:
+            log(f"workdir kept: {workdir}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -299,9 +299,11 @@ def _sync_lint_targets():
     drain) carries the same zero-hidden-syncs contract as the
     train/decode loops, and the resilience observers (watchdog thread,
     sentinel, fault plan) run INSIDE those loops so a hidden sync there
-    is a hidden sync in the loop."""
+    is a hidden sync in the loop.  ``data`` rides the same contract: the
+    prefetch producers and the integrity verifier run host-side work
+    that must never touch a device value."""
     targets = [os.path.join(REPO, "sat_tpu", "runtime.py")]
-    for sub in ("serve", "resilience"):
+    for sub in ("serve", "resilience", "data"):
         sub_dir = os.path.join(REPO, "sat_tpu", sub)
         targets.extend(
             os.path.join(sub_dir, f)
