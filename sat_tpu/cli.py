@@ -150,6 +150,12 @@ def build_config(argv: Optional[List[str]] = None):
              "(docs/OBSERVABILITY.md)",
     )
     p.add_argument(
+        "--metrics_port", type=int, default=None, metavar="PORT",
+        help="train phase: read-only Prometheus /metrics + /healthz "
+             "scrape endpoint riding the heartbeat payload (default 0 = "
+             "off; the serve phase exposes /metrics on its own port)",
+    )
+    p.add_argument(
         "--trace_export", default=None, metavar="PATH",
         help="Chrome trace-event JSON output path (default "
              "<summary_dir>/telemetry/trace.json when --telemetry is on); "
@@ -249,6 +255,8 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(telemetry=True)
     if args.heartbeat_interval is not None:
         config = config.replace(heartbeat_interval=args.heartbeat_interval)
+    if args.metrics_port is not None:
+        config = config.replace(metrics_port=args.metrics_port)
     if args.trace_export is not None:
         config = config.replace(trace_export=args.trace_export)
     if args.diag_level is not None:

@@ -151,6 +151,27 @@ class Config:
     # "basic" adds global scalars; "full" adds per-layer-group norms that
     # let the anomaly sentinel name which tensor went non-finite.
     diag_level: str = "off"
+    # read-only Prometheus scrape endpoint for TRAINING runs (serving
+    # exposes /metrics on its own port): GET /metrics + /healthz riding
+    # the heartbeat payload (telemetry/promtext.py).  0 = off.
+    metrics_port: int = 0
+    # size cap per rotating telemetry JSONL (telemetry.jsonl /
+    # access.jsonl / slo.jsonl — single .1 rollover, so at most 2x this
+    # on disk per file).  0 = unbounded (the pre-rotation behavior).
+    telemetry_log_cap_mb: float = 64.0
+    # on-demand live profiler window length (POST /profile default and
+    # the SIGUSR2 train trigger; telemetry/profwin.py clamps to its
+    # hard cap)
+    profile_window_ms: float = 2000.0
+    # ---- SLO objectives (telemetry/slo.py; 0 target = disabled) ----
+    # burning = both windows violate: the fast window pages quickly, the
+    # slow window suppresses blips
+    slo_window_fast_s: float = 60.0
+    slo_window_slow_s: float = 300.0
+    slo_serve_p99_ms: float = 0.0      # serve: p99 of serve/request
+    slo_error_ratio: float = 0.0       # serve: 5xx / all requests
+    slo_captions_per_s: float = 0.0    # train: step rate x batch_size floor
+    slo_ckpt_age_s: float = 0.0        # train: newest-checkpoint age ceiling
 
     # ---- online serving (docs/SERVING.md; no reference equivalent) ----
     # Request-driven captioning service (sat_tpu/serve): a stdlib HTTP
@@ -328,6 +349,35 @@ class Config:
         if self.telemetry_buffer <= 0:
             raise ValueError(
                 f"Config.telemetry_buffer={self.telemetry_buffer}: must be > 0"
+            )
+        if self.metrics_port < 0 or self.telemetry_log_cap_mb < 0:
+            raise ValueError(
+                "Config.metrics_port and telemetry_log_cap_mb must be >= 0"
+            )
+        if self.profile_window_ms <= 0:
+            raise ValueError(
+                f"Config.profile_window_ms={self.profile_window_ms}: "
+                "must be > 0"
+            )
+        if (
+            self.slo_window_fast_s <= 0
+            or self.slo_window_slow_s < self.slo_window_fast_s
+        ):
+            raise ValueError(
+                "Config.slo_window_fast_s must be > 0 and <= "
+                "slo_window_slow_s (fast pages, slow confirms)"
+            )
+        if min(
+            self.slo_serve_p99_ms,
+            self.slo_error_ratio,
+            self.slo_captions_per_s,
+            self.slo_ckpt_age_s,
+        ) < 0:
+            raise ValueError("Config.slo_* targets must be >= 0 (0 = off)")
+        if self.slo_error_ratio > 1:
+            raise ValueError(
+                f"Config.slo_error_ratio={self.slo_error_ratio}: a ratio "
+                "target cannot exceed 1"
             )
         buckets = tuple(self.serve_buckets)
         if buckets != self.serve_buckets:

@@ -45,6 +45,11 @@ Knobs::
                                batch at the result drain: its requests
                                must fail 500, /healthz must degrade to
                                503, and the engine re-warms
+    SAT_FI_SLOW_SERVE_MS=m     add m milliseconds of host-side stall to
+                               every serve batch's result drain (a
+                               degraded-but-alive serving device; the
+                               latency SLO must start burning while the
+                               wedge watchdog stays quiet)
     SAT_FI_CORRUPT_SHARD_ROW=k overwrite the first bytes of row k of
                                shard-00000.npy when the shard cache is
                                resolved (bit rot in a data shard; the
@@ -114,6 +119,7 @@ class FaultPlan:
     wedge_at_step: Optional[int] = None
     slow_step_ms: Optional[int] = None
     wedge_serve_batch: Optional[int] = None
+    slow_serve_ms: Optional[int] = None
     corrupt_shard_row: Optional[int] = None
     bad_image_every: Optional[int] = None
     bad_caption_at: Optional[int] = None
@@ -130,6 +136,7 @@ class FaultPlan:
             wedge_at_step=_env_int(env, "WEDGE_AT_STEP"),
             slow_step_ms=_env_int(env, "SLOW_STEP_MS"),
             wedge_serve_batch=_env_int(env, "WEDGE_SERVE_BATCH"),
+            slow_serve_ms=_env_int(env, "SLOW_SERVE_MS"),
             corrupt_shard_row=_env_int(env, "CORRUPT_SHARD_ROW"),
             bad_image_every=_env_int(env, "BAD_IMAGE_EVERY"),
             bad_caption_at=_env_int(env, "BAD_CAPTION_AT"),
@@ -145,6 +152,7 @@ class FaultPlan:
             and self.wedge_at_step is None
             and self.slow_step_ms is None
             and self.wedge_serve_batch is None
+            and self.slow_serve_ms is None
             and self.corrupt_shard_row is None
             and self.bad_image_every is None
             and self.bad_caption_at is None
@@ -202,6 +210,14 @@ class FaultPlan:
         if self.slow_step_ms is None:
             return
         time.sleep(self.slow_step_ms / 1e3)
+
+    def maybe_slow_serve(self) -> None:
+        """At every serve result drain: stall ``slow_serve_ms`` of host
+        time.  Degraded-but-alive serving — request latency inflates (the
+        latency SLO's test signal) but batches still complete."""
+        if self.slow_serve_ms is None:
+            return
+        time.sleep(self.slow_serve_ms / 1e3)
 
     def maybe_wedge_serve(self, batch_index: int) -> bool:
         """At the serve result drain, for the ``batch_index``-th (1-based)

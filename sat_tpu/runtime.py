@@ -580,6 +580,10 @@ def train(
     import contextlib
 
     final_path: Optional[str] = None
+    # on-demand live profiler window (telemetry/profwin.py): armed below
+    # when telemetry is on; SIGUSR2 latches a flag the log boundary drains
+    profile_trigger = None
+    profile_latch = None
     # the ExitStack drains the async writer LAST (after SummaryWriter
     # closes), on success and on exception alike — queued checkpoint
     # writes survive an interrupt and worker failures surface
@@ -603,6 +607,56 @@ def train(
                 )
                 _stack.callback(hb.stop)
                 hb.start()
+            else:
+                hb = None
+            # read-only Prometheus scrape endpoint (telemetry/promtext.py)
+            # riding the heartbeat payload — zero new syncs, a bind
+            # failure degrades to a warning
+            if config.metrics_port > 0:
+                from .telemetry.promtext import MetricsListener
+
+                ml = MetricsListener(
+                    "127.0.0.1",
+                    config.metrics_port,
+                    tel,
+                    payload_fn=hb.payload if hb is not None else None,
+                )
+                if ml.start():
+                    _stack.callback(ml.stop)
+            # SLO engine (telemetry/slo.py): declared train objectives
+            # (captions/s floor, checkpoint-age ceiling) evaluated on a
+            # side thread; transitions land in slo.jsonl and slo/* gauges
+            # surface in heartbeat.json
+            from .telemetry.slo import SLOEngine, objectives_from_config
+
+            slo_objectives = objectives_from_config(config, "train")
+            if slo_objectives:
+                slo_engine = SLOEngine(
+                    tel,
+                    slo_objectives,
+                    jsonl_path=os.path.join(
+                        _telemetry_dir(config), "slo.jsonl"
+                    ),
+                    cap_bytes=int(config.telemetry_log_cap_mb * 1e6),
+                    fast_s=config.slo_window_fast_s,
+                    slow_s=config.slo_window_slow_s,
+                ).start(
+                    interval_s=max(
+                        0.1, min(5.0, config.slo_window_fast_s / 4)
+                    )
+                )
+                _stack.callback(slo_engine.stop)
+            # SIGUSR2 → bounded live profiler capture, drained at the log
+            # boundary (signals are async; profiler starts are not)
+            import signal as _signal
+
+            from .telemetry.profwin import ProfileLatch, SignalTrigger
+
+            profile_latch = ProfileLatch(_telemetry_dir(config))
+            _stack.callback(profile_latch.stop_now)
+            profile_trigger = SignalTrigger()
+            if hasattr(_signal, "SIGUSR2"):
+                profile_trigger.install(_signal.SIGUSR2)
         if async_writer:
             _stack.callback(async_writer.close)
         if config.watchdog_interval > 0:
@@ -721,7 +775,26 @@ def train(
                                     _telemetry_dir(config), "telemetry.jsonl"
                                 ),
                                 step,
+                                cap_bytes=int(
+                                    config.telemetry_log_cap_mb * 1e6
+                                ),
                             )
+                            # SIGUSR2 since the last boundary → start a
+                            # bounded live profiler window (refusals —
+                            # capture already running — just log)
+                            if (
+                                profile_trigger is not None
+                                and profile_trigger.pop()
+                            ):
+                                ok, info = profile_latch.start(
+                                    config.profile_window_ms
+                                )
+                                print(
+                                    "sat_tpu: live profiler window "
+                                    + (f"-> {info}" if ok else f"refused ({info})"),
+                                    file=sys.stderr,
+                                    flush=True,
+                                )
                         if sentinel.check(step, host) == "rollback":
                             rollback = True
                             break

@@ -64,6 +64,15 @@ class Request:
     result: Optional[Dict[str, Any]] = None
     error: Optional[Tuple[int, str]] = None
     bucket: Optional[int] = None
+    # request-scoped tracing (telemetry.tracectx): stamped when the
+    # gather loop pops this request; the trace rides along so the batcher
+    # can attribute each phase to the originating X-Request-Id
+    t_gather_ns: Optional[int] = None
+    trace: Optional[Any] = None
+
+    def mark(self, phase: str, t0_ns: int, dur_ns: int) -> None:
+        if self.trace is not None:
+            self.trace.mark(phase, t0_ns, dur_ns)
 
     def fail(self, status: int, reason: str) -> None:
         self.error = (status, reason)
@@ -122,7 +131,10 @@ class MicroBatcher:
     # -- admission (called from HTTP worker threads) -----------------------
 
     def submit(
-        self, image: np.ndarray, deadline_unix: Optional[float] = None
+        self,
+        image: np.ndarray,
+        deadline_unix: Optional[float] = None,
+        trace: Optional[Any] = None,
     ) -> Request:
         """Admit one preprocessed image; raises Rejected(503) while
         draining and Rejected(429) when the queue is full."""
@@ -133,6 +145,7 @@ class MicroBatcher:
             image=image,
             t_submit_ns=time.perf_counter_ns(),
             deadline_unix=deadline_unix,
+            trace=trace,
         )
         try:
             self._q.put_nowait(req)
@@ -184,6 +197,7 @@ class MicroBatcher:
             except queue.Empty:
                 if self._draining.is_set():
                     return None
+        first.t_gather_ns = time.perf_counter_ns()
         batch = [first]
         flush_at = time.monotonic() + self.max_wait_s
         while len(batch) < self.max_batch:
@@ -191,9 +205,11 @@ class MicroBatcher:
             if wait <= 0:
                 break
             try:
-                batch.append(self._q.get(timeout=wait))
+                rider = self._q.get(timeout=wait)
             except queue.Empty:
                 break
+            rider.t_gather_ns = time.perf_counter_ns()
+            batch.append(rider)
         return batch
 
     def _admit(self, batch: List[Request]) -> List[Request]:
@@ -206,6 +222,12 @@ class MicroBatcher:
             self._tel.record(
                 "serve/queue_wait", r.t_submit_ns, now_ns - r.t_submit_ns
             )
+            # per-request phase attribution: queue_wait ends when the
+            # gather loop popped the request; batch_form is the hold-open
+            # window between that pop and this dispatch boundary
+            t_gather = r.t_gather_ns if r.t_gather_ns is not None else now_ns
+            r.mark("queue_wait", r.t_submit_ns, t_gather - r.t_submit_ns)
+            r.mark("batch_form", t_gather, now_ns - t_gather)
             if r.deadline_unix is not None and now_unix > r.deadline_unix:
                 self._tel.count("serve/expired")
                 r.fail(504, "deadline expired while queued")
@@ -217,12 +239,14 @@ class MicroBatcher:
         t0 = time.perf_counter_ns()
         batch, bucket = self.engine.pad_batch([r.image for r in live])
         out = self.engine.dispatch(batch)
-        self._tel.record("serve/dispatch", t0, time.perf_counter_ns() - t0)
+        t1 = time.perf_counter_ns()
+        self._tel.record("serve/dispatch", t0, t1 - t0)
         self._tel.count("serve/batches")
         self._tel.count(f"serve/bucket_{bucket}")
         self._tel.count("serve/padded_rows", bucket - len(live))
         for r in live:
             r.bucket = bucket
+            r.mark("dispatch", t0, t1 - t0)
         return out
 
     def _bounded_decode(self, decode: Callable[[], Any]):
@@ -253,20 +277,31 @@ class MicroBatcher:
     def _finish(self, entry) -> None:
         out, live, index = entry
 
-        def _decode():
+        def _drain():
             if self._plan.maybe_wedge_serve(index):
                 # injected stuck batch: park exactly like a drain whose
                 # device never answers (interruptible only by process exit)
                 time.sleep(3600.0)
-            return self.engine.decode_output(out, len(live))
+            self._plan.maybe_slow_serve()
+            return self.engine.drain_output(out, len(live))
 
         try:
             t0 = time.perf_counter_ns()
+            # only the device drain is wedge-bounded — detok is pure host
+            # work that cannot hang on the device
             if self.wedge_timeout_s > 0:
-                results = self._bounded_decode(_decode)
+                arrays = self._bounded_decode(_drain)
             else:
-                results = _decode()
-            self._tel.record("serve/detok", t0, time.perf_counter_ns() - t0)
+                arrays = _drain()
+            t1 = time.perf_counter_ns()
+            results = self.engine.detok_rows(arrays, len(live))
+            t2 = time.perf_counter_ns()
+            # the aggregate span keeps its pre-split meaning (drain+detok)
+            # so /stats latency percentiles stay comparable across runs
+            self._tel.record("serve/detok", t0, t2 - t0)
+            for r in live:
+                r.mark("drain", t0, t1 - t0)
+                r.mark("detok", t1, t2 - t1)
         except _WedgeTimeout:
             # the batch is gone; its requesters get a fast 500 and the
             # server's hook degrades health + re-warms the engine

@@ -224,9 +224,12 @@ class ServeEngine:
         contexts = enc_exec(self._variables, jax.device_put(images))
         return beam_exec(self._decoder_params, contexts)
 
-    def decode_output(self, out, n: int) -> List[Dict[str, Any]]:
-        """Drain the device result for the ``n`` live rows and detokenize
-        every beam.  This is the serve path's one host↔device sync."""
+    def drain_output(self, out, n: int) -> Tuple[np.ndarray, ...]:
+        """Drain the device result for the ``n`` live rows: host arrays
+        (words, lengths, log_scores).  This is the serve path's one
+        host↔device sync — split from detokenization so the batcher can
+        time (and the request tracer attribute) device wait separately
+        from host string work."""
         # Whole-array transfers, sliced on the HOST: a device-side [:n]
         # slice is itself a jitted gather that would compile once per
         # distinct n — a hidden recompile the zero-recompile guarantee
@@ -234,6 +237,14 @@ class ServeEngine:
         words = np.asarray(out.words)[:n]  # sync-ok: serve detok boundary — batch results drained once
         lengths = np.asarray(out.lengths)[:n]  # sync-ok: serve detok boundary
         scores = np.asarray(out.log_scores)[:n]  # sync-ok: serve detok boundary
+        return words, lengths, scores
+
+    def detok_rows(
+        self, arrays: Tuple[np.ndarray, ...], n: int
+    ) -> List[Dict[str, Any]]:
+        """Detokenize every beam of ``n`` drained rows — pure host work on
+        numpy arrays, no device access."""
+        words, lengths, scores = arrays
         results = []
         for i in range(n):
             captions = []
@@ -250,3 +261,8 @@ class ServeEngine:
                 )
             results.append({"captions": captions})
         return results
+
+    def decode_output(self, out, n: int) -> List[Dict[str, Any]]:
+        """Drain + detokenize in one call (the pre-split contract; the
+        batcher now calls the halves separately to time them)."""
+        return self.detok_rows(self.drain_output(out, n), n)

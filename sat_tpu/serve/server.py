@@ -19,6 +19,18 @@ Endpoints:
 * ``GET /stats`` — queue depth, bucket histogram, serve counters, and
   p50/p95/p99 latency per serve span (queue_wait / preprocess / dispatch
   / detok / request) from the telemetry ring.
+* ``GET /metrics`` — Prometheus text exposition (format 0.0.4) of every
+  counter/gauge/span aggregate (telemetry.promtext).
+* ``POST /profile?duration_ms=N`` — start a bounded live ``jax.profiler``
+  capture into ``<telemetry_dir>/profiles/<ts>/``; 409 while another
+  capture runs, duration clamped to the hard cap (telemetry.profwin).
+
+Every reply — including 400/429/503/504 sheds and 404s — echoes
+``X-Request-Id`` (inbound value sanitized, or minted), and each
+``POST /caption`` is traced per phase into ``access.jsonl`` plus its own
+Perfetto lane (telemetry.tracectx).  Declared SLOs (``slo_*`` config)
+are evaluated continuously; a burning objective flips ``/healthz`` to
+503 "degraded" with the objective named (telemetry.slo).
 
 Shutdown: SIGTERM/SIGINT (via ``resilience.preempt.GracefulShutdown``)
 or ``request_shutdown()`` triggers the drain sequence — readiness flips
@@ -42,7 +54,10 @@ from .. import telemetry
 from ..config import Config
 from ..data.vocabulary import Vocabulary
 from ..resilience.preempt import GracefulShutdown
+from ..telemetry import promtext, tracectx
 from ..telemetry.heartbeat import Heartbeat
+from ..telemetry.profwin import ProfileLatch
+from ..telemetry.slo import SLOEngine, objectives_from_config
 from .batcher import MicroBatcher, Rejected
 from .engine import ServeEngine, load_serving_state
 
@@ -80,41 +95,83 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # stderr per-request noise: off
         pass
 
-    def _reply(self, status: int, payload: Dict[str, Any]) -> None:
-        body = json.dumps(payload).encode()
+    def _request_id(self) -> str:
+        return tracectx.ensure_id(self.headers.get(tracectx.TRACE_HEADER))
+
+    def _send(self, status: int, body: bytes, ctype: str, rid: str) -> None:
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        # EVERY reply carries the correlation id — sheds and 404s too,
+        # so clients can correlate a reject with their own logs
+        self.send_header(tracectx.TRACE_HEADER, rid)
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply(self, status: int, payload: Dict[str, Any], rid: str) -> None:
+        self._send(status, json.dumps(payload).encode(), "application/json", rid)
+
     def do_GET(self) -> None:
         app = self.server.app
-        if self.path.startswith("/healthz"):
+        rid = self._request_id()
+        route = self.path.split("?", 1)[0]
+        if route == "/healthz":
             payload, status = app.healthz()
-            self._reply(status, payload)
-        elif self.path.startswith("/stats"):
-            self._reply(200, app.stats())
+            self._reply(status, payload, rid)
+        elif route == "/stats":
+            self._reply(200, app.stats(), rid)
+        elif route == "/metrics":
+            self._send(
+                200, app.metrics_text().encode(), promtext.CONTENT_TYPE, rid
+            )
         else:
-            self._reply(404, {"error": f"no route {self.path}"})
+            self._reply(404, {"error": f"no route {self.path}"}, rid)
 
     def do_POST(self) -> None:
         app = self.server.app
-        if not self.path.startswith("/caption"):
-            self._reply(404, {"error": f"no route {self.path}"})
+        rid = self._request_id()
+        route, _, query = self.path.partition("?")
+        if route == "/profile":
+            import urllib.parse
+
+            params = urllib.parse.parse_qs(query)
+            try:
+                duration_ms = (
+                    int(params["duration_ms"][0])
+                    if "duration_ms" in params
+                    else None
+                )
+            except (ValueError, IndexError):
+                self._reply(
+                    400, {"error": "duration_ms must be an integer"}, rid
+                )
+                return
+            ok, info = app.start_profile(duration_ms)
+            if ok:
+                self._reply(
+                    200, {"profile_dir": info, "duration_ms": duration_ms}, rid
+                )
+            else:
+                status = 409 if "in progress" in info else 503
+                self._reply(status, {"error": info}, rid)
+            return
+        if route != "/caption":
+            self._reply(404, {"error": f"no route {self.path}"}, rid)
             return
         try:
             length = int(self.headers.get("Content-Length", "0"))
         except ValueError:
             length = 0
         if length <= 0:
-            self._reply(400, {"error": "empty body; POST image bytes"})
+            self._reply(400, {"error": "empty body; POST image bytes"}, rid)
             return
         body = self.rfile.read(length)
         status, payload = app.handle_caption(
-            body, deadline_ms=self.headers.get("X-Deadline-Ms")
+            body,
+            deadline_ms=self.headers.get("X-Deadline-Ms"),
+            request_id=rid,
         )
-        self._reply(status, payload)
+        self._reply(status, payload, rid)
 
 
 class CaptionServer:
@@ -163,6 +220,25 @@ class CaptionServer:
         self._degraded = False
         self._t_start = time.time()
         self.heartbeat: Optional[Heartbeat] = None
+        # fleet observability (telemetry.tracectx/profwin/slo): the
+        # request tracer, the live-profile latch, and the SLO engine all
+        # share the telemetry dir and the rotating-sink byte cap
+        tdir = config.telemetry_dir or os.path.join(
+            config.summary_dir, "telemetry"
+        )
+        cap_bytes = int(config.telemetry_log_cap_mb * 1e6)
+        self.tracer = tracectx.RequestTracer(
+            path=os.path.join(tdir, "access.jsonl"), cap_bytes=cap_bytes
+        )
+        self.profiles = ProfileLatch(tdir)
+        self.slo = SLOEngine(
+            self._tel,
+            objectives_from_config(config, "serve"),
+            jsonl_path=os.path.join(tdir, "slo.jsonl"),
+            cap_bytes=cap_bytes,
+            fast_s=config.slo_window_fast_s,
+            slow_s=config.slo_window_slow_s,
+        )
 
     @property
     def port(self) -> Optional[int]:
@@ -174,12 +250,42 @@ class CaptionServer:
 
     # -- request handlers (HTTP worker threads) ----------------------------
 
+    def _finish_request(
+        self,
+        trace: "tracectx.RequestTrace",
+        status: int,
+        payload: Dict[str, Any],
+        bucket: Optional[int] = None,
+    ) -> Tuple[int, Dict[str, Any]]:
+        """Every terminal /caption reply funnels through here: the access
+        log gets its record, the SLO error-ratio counters tick, and the
+        payload learns its request id."""
+        total_ns = time.perf_counter_ns() - trace.t_start_ns
+        self._tel.count("serve/http_requests")
+        if status >= 500:
+            self._tel.count("serve/http_5xx")
+        self.tracer.finish(
+            trace,
+            status,
+            total_ns,
+            bucket=bucket,
+            error=payload.get("error"),
+        )
+        payload["request_id"] = trace.trace_id
+        return status, payload
+
     def handle_caption(
-        self, body: bytes, deadline_ms=None
+        self, body: bytes, deadline_ms=None, request_id=None
     ) -> Tuple[int, Dict[str, Any]]:
         t_req0 = time.perf_counter_ns()
+        trace = self.tracer.begin(request_id)
+        trace.t_start_ns = t_req0
         if not self._ready:
-            return 503, {"error": "server is draining; not accepting work"}
+            return self._finish_request(
+                trace,
+                503,
+                {"error": "server is draining; not accepting work"},
+            )
         try:
             with self._tel.span("serve/preprocess"):
                 image = self.engine.preprocess(body)
@@ -187,45 +293,64 @@ class CaptionServer:
             # undecodable POST body: a client problem, not a server crash —
             # counted so a flood of garbage uploads shows in the heartbeat
             self._tel.count("serve/bad_input")
-            return 400, {
-                "error": "bad image",
-                "detail": f"cannot decode image bytes: {e}",
-            }
+            return self._finish_request(
+                trace,
+                400,
+                {
+                    "error": "bad image",
+                    "detail": f"cannot decode image bytes: {e}",
+                },
+            )
         if deadline_ms is None or deadline_ms == "":
             budget_ms = self.config.serve_deadline_ms
         else:
             try:
                 budget_ms = int(deadline_ms)
             except (TypeError, ValueError):
-                return 400, {
-                    "error": "X-Deadline-Ms must be integer milliseconds"
-                }
+                return self._finish_request(
+                    trace,
+                    400,
+                    {"error": "X-Deadline-Ms must be integer milliseconds"},
+                )
         deadline_unix = (
             time.time() + budget_ms / 1e3 if budget_ms > 0 else None
         )
         try:
-            req = self.batcher.submit(image, deadline_unix=deadline_unix)
+            req = self.batcher.submit(
+                image, deadline_unix=deadline_unix, trace=trace
+            )
         except Rejected as e:
-            return e.status, {"error": e.reason}
+            return self._finish_request(trace, e.status, {"error": e.reason})
         wait_s = (
             budget_ms / 1e3 + 5.0 if deadline_unix else self.DEFAULT_WAIT_S
         )
         if not req.done.wait(timeout=wait_s):
             self._tel.count("serve/timeouts")
-            return 504, {"error": "request timed out in service"}
+            return self._finish_request(
+                trace, 504, {"error": "request timed out in service"}
+            )
         if req.error is not None:
-            return req.error[0], {"error": req.error[1]}
+            return self._finish_request(
+                trace,
+                req.error[0],
+                {"error": req.error[1]},
+                bucket=req.bucket,
+            )
         self._tel.record(
             "serve/request", t_req0, time.perf_counter_ns() - t_req0
         )
         payload = dict(req.result)
         payload["bucket"] = req.bucket
         payload["model_step"] = self.engine.step
-        return 200, payload
+        return self._finish_request(trace, 200, payload, bucket=req.bucket)
 
     def healthz(self) -> Tuple[Dict[str, Any], int]:
         payload = self.heartbeat.payload() if self.heartbeat else {}
-        degraded = self._degraded
+        # two degrade causes (docs/RESILIENCE.md): a wedged batch being
+        # re-warmed, and a burning SLO — both flip the balancer-facing
+        # health while requests are still admitted
+        burning = self.slo.burning()
+        degraded = self._degraded or bool(burning)
         payload.update(
             {
                 "ready": self._ready,
@@ -240,6 +365,8 @@ class CaptionServer:
                 "model_step": self.engine.step,
             }
         )
+        if burning:
+            payload["slo_burning"] = burning
         return payload, (200 if self._ready and not degraded else 503)
 
     # -- wedge containment (called from the batcher thread) ----------------
@@ -305,7 +432,42 @@ class CaptionServer:
                 if k.startswith(("serve/", "jax/"))
             },
             "latency_ms": latency,
+            "slo": self.slo.snapshot(),
+            "profile_captures": self.profiles.captures,
         }
+
+    # -- observability endpoints -------------------------------------------
+
+    def metrics_text(self) -> str:
+        """The Prometheus exposition body for ``GET /metrics``."""
+        extra = self.heartbeat.payload() if self.heartbeat else None
+        return promtext.render(self._tel, extra=extra)
+
+    def start_profile(self, duration_ms=None) -> Tuple[bool, str]:
+        """Begin a bounded live profiler capture (``POST /profile``);
+        409-maps when one is already running."""
+        ok, info = self.profiles.start(duration_ms)
+        if ok:
+            self._tel.count("serve/profile_windows")
+        return ok, info
+
+    def export_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Chrome trace including one lane per retained request
+        (tests call this directly; shutdown calls it when
+        ``--trace_export`` is set)."""
+        from ..telemetry import exporters
+
+        if path is None:
+            path = self.config.trace_export
+        if not path:
+            return None
+        return exporters.export_chrome_trace(
+            self._tel,
+            path,
+            extra_events=self.tracer.trace_events(
+                getattr(self._tel, "anchor_ns", 0), pid=os.getpid()
+            ),
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -341,6 +503,11 @@ class CaptionServer:
                 ).start()
             except OSError:
                 self.heartbeat = None  # health still served from /healthz
+        if self.slo.objectives:
+            # tick a few times per fast window so a burn is seen promptly
+            self.slo.start(
+                interval_s=max(0.1, min(5.0, self.config.slo_window_fast_s / 4))
+            )
         self._ready = True
         self._tel.gauge("serve/ready", 1)
         return self
@@ -364,6 +531,9 @@ class CaptionServer:
             self._http_thread = None
         self._httpd.server_close()
         self._httpd = None
+        self.slo.stop()
+        self.profiles.stop_now()
+        self.export_trace()  # no-op unless --trace_export is set
         if self.heartbeat is not None:
             self.heartbeat.stop()
 

@@ -38,12 +38,18 @@ from . import run_id
 # ---------------------------------------------------------------------------
 
 
-def chrome_trace(tel, process_name: str = "sat_tpu host") -> Dict:
+def chrome_trace(
+    tel,
+    process_name: str = "sat_tpu host",
+    extra_events: Optional[List[Dict]] = None,
+) -> Dict:
     """The trace-event document for ``tel``'s retained span window.
 
     Timestamps are microseconds since the recorder's anchor; the absolute
     anchor (unix seconds) rides in ``otherData`` for post-hoc alignment
-    with ``metrics.jsonl``'s wall-clock stamps.
+    with ``metrics.jsonl``'s wall-clock stamps.  ``extra_events`` are
+    pre-built trace events appended verbatim — the request lanes from
+    ``tracectx.RequestTracer.trace_events`` ride in through here.
     """
     names, ids, t0s, durs, tids = tel.spans_snapshot()
     pid = os.getpid()
@@ -68,6 +74,8 @@ def chrome_trace(tel, process_name: str = "sat_tpu host") -> Dict:
                 "dur": int(durs[k]) / 1e3,
             }
         )
+    if extra_events:
+        events.extend(extra_events)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -80,11 +88,13 @@ def chrome_trace(tel, process_name: str = "sat_tpu host") -> Dict:
     }
 
 
-def export_chrome_trace(tel, path: str) -> Optional[str]:
+def export_chrome_trace(
+    tel, path: str, extra_events: Optional[List[Dict]] = None
+) -> Optional[str]:
     """Write the Perfetto-loadable trace JSON atomically; returns the path
     (None when the write failed — reported, never raised)."""
     try:
-        doc = chrome_trace(tel)
+        doc = chrome_trace(tel, extra_events=extra_events)
         atomic_write(path, "w", lambda f: json.dump(doc, f))
         return path
     except (OSError, ValueError) as e:
@@ -126,20 +136,59 @@ def snapshot_row(tel, step: Optional[int] = None) -> Dict:
     return row
 
 
-def append_jsonl(tel, path: str, step: Optional[int] = None) -> None:
-    """Append one snapshot row; failures degrade to a one-line warning
-    (tracked by the ``telemetry/export_errors`` counter)."""
+def rotating_append(
+    path: str, line: str, cap_bytes: int = 0, tel=None
+) -> bool:
+    """Append one line to a size-capped JSONL file.
+
+    When the file would grow past ``cap_bytes`` the current file rolls to
+    ``<path>.1`` (single rollover — at most ``2 * cap_bytes`` on disk, the
+    previous ``.1`` is dropped) and the append lands in a fresh file.
+    ``cap_bytes <= 0`` disables rotation.  Failures degrade to a one-line
+    warning (and the ``telemetry/export_errors`` counter when ``tel`` is
+    given) — the shared sink for ``telemetry.jsonl`` / ``access.jsonl`` /
+    ``slo.jsonl``, so none of them can fill a disk or kill a run.
+    Returns True when the line landed."""
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        data = line if line.endswith("\n") else line + "\n"
+        if cap_bytes > 0:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+            if size and size + len(data.encode("utf-8")) > cap_bytes:
+                os.replace(path, path + ".1")
         with open(path, "a") as f:
-            f.write(json.dumps(snapshot_row(tel, step)) + "\n")
+            f.write(data)
+        return True
     except (OSError, ValueError) as e:
+        if tel is not None:
+            tel.count("telemetry/export_errors")
+        print(
+            f"sat_tpu: telemetry append failed ({path}): {e}",
+            file=sys.stderr,
+            flush=True,
+        )
+        return False
+
+
+def append_jsonl(
+    tel, path: str, step: Optional[int] = None, cap_bytes: int = 0
+) -> None:
+    """Append one snapshot row through the rotating sink; failures degrade
+    to a one-line warning (tracked by ``telemetry/export_errors``)."""
+    try:
+        line = json.dumps(snapshot_row(tel, step))
+    except (TypeError, ValueError) as e:
         tel.count("telemetry/export_errors")
         print(
             f"sat_tpu: telemetry.jsonl append failed ({path}): {e}",
             file=sys.stderr,
             flush=True,
         )
+        return
+    rotating_append(path, line, cap_bytes, tel=tel)
 
 
 # ---------------------------------------------------------------------------

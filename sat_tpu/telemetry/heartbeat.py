@@ -31,7 +31,7 @@ import time
 from typing import Dict, Optional
 
 from ..utils.fileio import atomic_write
-from . import run_id
+from . import SCHEMA_VERSION, run_id
 
 
 def _rss_bytes() -> int:
@@ -96,6 +96,9 @@ class Heartbeat:
             self._prev = (now, step)
         last_save = gauges.get("ckpt/last_save_unix")
         payload = {
+            # consumers get the same contract check_regression gives bench
+            # rows: refuse payloads whose schema they don't understand
+            "schema_version": SCHEMA_VERSION,
             "run_id": run_id(),
             "seq": self._seq,
             "pid": os.getpid(),
@@ -156,6 +159,14 @@ class Heartbeat:
         }
         if data:
             payload["data"] = data
+        # SLO engine state (telemetry.slo): per-objective burn rate and
+        # burning flag plus the burning_total roll-up — the heartbeat is
+        # where an outside watcher sees an objective start to burn
+        slo = {
+            k[len("slo/"):]: v for k, v in gauges.items() if k.startswith("slo/")
+        }
+        if slo:
+            payload["slo"] = slo
         if self._sampler is not None:
             try:
                 payload.update(self._sampler() or {})

@@ -1,0 +1,156 @@
+"""On-demand profiler windows: bounded live captures without a restart.
+
+`ProfilerWindow` (runtime.py) captures a *preconfigured* step range —
+you must know before launch what you want to see.  This module adds the
+other half: a capture you can trigger against a *running* process —
+``POST /profile?duration_ms=`` on the caption server, ``SIGUSR2`` on the
+train loop — when the thing you want to profile is happening right now.
+
+Safety contract, enforced here so every trigger path inherits it:
+
+* **single capture at a time** — ``jax.profiler`` keeps global state and
+  a second ``start_trace`` corrupts the first; the latch refuses
+  (serve maps the refusal to HTTP 409) instead of corrupting;
+* **hard duration cap** (:data:`HARD_CAP_MS`) — a fat-fingered
+  ``duration_ms=9999999`` must not profile-tax a production server for
+  hours; requests clamp, silently;
+* **degrade-don't-raise** — a failed ``start_trace`` (no profiler build,
+  bad dir) releases the latch and reports the reason; triggering a
+  capture can never take the serving process down.
+
+Captures land in ``<base_dir>/profiles/<stamp>/`` (TensorBoard- and
+``scripts/profile_trace.sh``-loadable).  The module imports no jax at
+module scope — jax loads lazily inside :meth:`start`, keeping the
+telemetry package importable in jax-free tools.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Optional, Tuple
+
+# no live window may exceed one minute — long captures belong to the
+# preconfigured ProfilerWindow path where the operator planned for them
+HARD_CAP_MS = 60_000.0
+MIN_MS = 1.0
+
+DEFAULT_WINDOW_MS = 2000.0
+
+
+class ProfileLatch:
+    """Single-capture-at-a-time gate over ``jax.profiler`` live traces."""
+
+    def __init__(self, base_dir: str) -> None:
+        self.base_dir = base_dir
+        self._lock = threading.Lock()
+        self._active_dir: Optional[str] = None
+        self._timer: Optional[threading.Timer] = None
+        self.captures = 0  # completed-or-started count, for stats
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._active_dir is not None
+
+    def start(self, duration_ms: Optional[float] = None) -> Tuple[bool, str]:
+        """Begin a bounded capture; returns ``(ok, path_or_reason)``.
+
+        ``(False, reason)`` when a capture is already running (the 409
+        path) or the profiler failed to start (degraded, latch released).
+        The capture stops itself after the (clamped) duration."""
+        if duration_ms is None:
+            duration_ms = DEFAULT_WINDOW_MS
+        duration_ms = min(max(duration_ms, MIN_MS), HARD_CAP_MS)
+        stamp = time.strftime("%Y%m%d-%H%M%S") + f"-{int(time.time() * 1e3) % 1000:03d}"
+        out_dir = os.path.join(self.base_dir, "profiles", stamp)
+        with self._lock:
+            if self._active_dir is not None:
+                return False, "capture already in progress"
+            self._active_dir = out_dir  # reserve before the slow open
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            import jax
+
+            jax.profiler.start_trace(out_dir)
+        except Exception as e:  # degrade: release the latch, report why
+            with self._lock:
+                self._active_dir = None
+            reason = f"profiler start failed: {e}"
+            print(f"sat_tpu: {reason}", file=sys.stderr, flush=True)
+            return False, reason
+        self.captures += 1
+        timer = threading.Timer(duration_ms / 1e3, self._finish)
+        timer.daemon = True
+        with self._lock:
+            self._timer = timer
+        timer.start()
+        return True, out_dir
+
+    def _finish(self) -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:
+            print(
+                f"sat_tpu: profiler stop failed: {e}",
+                file=sys.stderr,
+                flush=True,
+            )
+        finally:
+            with self._lock:
+                self._active_dir = None
+                self._timer = None
+
+    def stop_now(self) -> None:
+        """End an active capture early (shutdown path); no-op when idle."""
+        with self._lock:
+            timer = self._timer
+            active = self._active_dir is not None
+        if timer is not None:
+            timer.cancel()
+        if active:
+            self._finish()
+
+
+class SignalTrigger:
+    """A latched flag set by a POSIX signal, drained at a safe boundary.
+
+    The train loop installs this on ``SIGUSR2`` and polls :meth:`pop` at
+    the ``log_every`` boundary — signals are async, profiler starts are
+    not, so the handler only sets a flag.  Installation degrades (warns,
+    stays un-installed) off the main thread or on platforms without the
+    signal, matching the rest of the observability stack."""
+
+    def __init__(self) -> None:
+        self._flag = threading.Event()
+        self.installed = False
+
+    def install(self, signum: int) -> bool:
+        import signal as _signal
+
+        try:
+            _signal.signal(signum, lambda *_args: self._flag.set())
+            self.installed = True
+        except (ValueError, OSError, AttributeError) as e:
+            # ValueError: not the main thread; others: platform quirks
+            print(
+                f"sat_tpu: profiler signal trigger unavailable: {e}",
+                file=sys.stderr,
+                flush=True,
+            )
+        return self.installed
+
+    def fire(self) -> None:
+        """Set the flag directly (tests; same path the handler takes)."""
+        self._flag.set()
+
+    def pop(self) -> bool:
+        """True once per firing: clears and returns the latched flag."""
+        if self._flag.is_set():
+            self._flag.clear()
+            return True
+        return False

@@ -1,0 +1,192 @@
+"""Prometheus text exposition (format 0.0.4) over the telemetry recorder.
+
+Everything rendered here is already host-side — counters, gauges, and
+span aggregates live in the recorder's rings, and the train-side extras
+come from the heartbeat payload that is computed anyway.  Exposition is
+therefore a pure read: zero device syncs, zero jax imports, no new
+state.  Three metric families cover the whole recorder without a
+registration step (new counters/gauges appear in the scrape the moment
+the code counts them):
+
+* ``sat_counter_total{name="serve/completed"}`` — monotonic counters;
+* ``sat_gauge{name="serve/queue_depth"}`` — last-set gauges, plus any
+  numeric scalars from an ``extra`` mapping (heartbeat payload fields
+  like ``steps_per_s`` ride in through here);
+* ``sat_span_seconds_count`` / ``sat_span_seconds_sum`` — per-span
+  summary pairs from :meth:`Telemetry.aggregates`, so Prometheus can
+  rate() a phase's time share the standard way.
+
+:class:`MetricsListener` is the training-side carrier: a stdlib
+threading HTTP server exposing ``GET /metrics`` (this format) and
+``GET /healthz`` (the heartbeat JSON) read-only — the caption server
+serves the same render from its own handler instead.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Mapping, Optional
+
+CONTENT_TYPE = "text/plain; version=0.0.4"
+
+_ESCAPES = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
+
+
+def _escape_label(value: str) -> str:
+    return "".join(_ESCAPES.get(ch, ch) for ch in value)
+
+
+def _fmt(value) -> str:
+    # Prometheus wants plain decimal or scientific notation; repr of a
+    # python int/float satisfies that, but bools must narrow to 0/1.
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    return repr(value) if isinstance(value, float) else str(int(value))
+
+
+def render(tel, extra: Optional[Mapping[str, object]] = None) -> str:
+    """The exposition document for ``tel``'s current state.
+
+    ``extra`` merges additional numeric scalars into the gauge family
+    (non-numeric values are skipped, not errors — callers hand whole
+    heartbeat payloads over without filtering)."""
+    lines: List[str] = []
+
+    counters = tel.counters()
+    lines.append("# HELP sat_counter_total sat_tpu telemetry counters")
+    lines.append("# TYPE sat_counter_total counter")
+    for name in sorted(counters):
+        lines.append(
+            f'sat_counter_total{{name="{_escape_label(name)}"}} '
+            f"{_fmt(counters[name])}"
+        )
+
+    gauges: Dict[str, object] = dict(tel.gauges())
+    if extra:
+        for key, value in extra.items():
+            if isinstance(value, (int, float)) and key not in gauges:
+                gauges[key] = value
+    lines.append("# HELP sat_gauge sat_tpu telemetry gauges")
+    lines.append("# TYPE sat_gauge gauge")
+    for name in sorted(gauges):
+        value = gauges[name]
+        if isinstance(value, (int, float)):
+            lines.append(
+                f'sat_gauge{{name="{_escape_label(name)}"}} {_fmt(value)}'
+            )
+
+    aggregates = tel.aggregates()
+    lines.append(
+        "# HELP sat_span_seconds host span durations (summary: count+sum)"
+    )
+    lines.append("# TYPE sat_span_seconds summary")
+    for name in sorted(aggregates):
+        count, total_ns, _ = aggregates[name]
+        label = _escape_label(name)
+        lines.append(f'sat_span_seconds_count{{span="{label}"}} {_fmt(count)}')
+        lines.append(
+            f'sat_span_seconds_sum{{span="{label}"}} '
+            f"{_fmt(round(total_ns / 1e9, 9))}"
+        )
+
+    lines.append("# HELP sat_up exposition endpoint liveness")
+    lines.append("# TYPE sat_up gauge")
+    lines.append("sat_up 1")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsListener:
+    """Read-only train-side scrape endpoint riding the heartbeat payload.
+
+    Binds ``host:port`` (port 0 picks an ephemeral one, read it back from
+    :attr:`port`), serves ``GET /metrics`` and ``GET /healthz``, and
+    degrades to a warning when the bind fails — an occupied port must
+    never kill a training run."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        tel,
+        payload_fn: Optional[Callable[[], Dict]] = None,
+    ) -> None:
+        self._tel = tel
+        self._payload_fn = payload_fn
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.host = host
+        self.port = int(port)
+
+    def start(self) -> bool:
+        listener = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:  # quiet by design
+                pass
+
+            def do_GET(self) -> None:
+                try:
+                    if self.path.split("?", 1)[0] == "/metrics":
+                        extra = None
+                        if listener._payload_fn is not None:
+                            extra = listener._payload_fn()
+                        body = render(listener._tel, extra=extra).encode()
+                        ctype = CONTENT_TYPE
+                    elif self.path.split("?", 1)[0] == "/healthz":
+                        payload = (
+                            listener._payload_fn()
+                            if listener._payload_fn is not None
+                            else {}
+                        )
+                        body = json.dumps(payload).encode()
+                        ctype = "application/json"
+                    else:
+                        body = b'{"error": "not found"}'
+                        ctype = "application/json"
+                        self.send_response(404)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+        try:
+            self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        except OSError as e:
+            print(
+                f"sat_tpu: metrics listener bind failed "
+                f"({self.host}:{self.port}): {e} — scrape endpoint disabled",
+                file=sys.stderr,
+                flush=True,
+            )
+            self._httpd = None
+            return False
+        self.port = self._httpd.server_address[1]
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.2},
+            name="sat-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+        return True
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -5,8 +5,32 @@
 # Writes <outdir>/profile_done.txt on success so tpu_retry.sh can treat
 # the trace as a stage artifact.
 #
+# Live-capture mode (ISSUE 9): point it at an already-running caption
+# server and it opens an on-demand profiler window over HTTP instead of
+# launching a fresh training run — no restart, no config edit:
+#
+#   bash scripts/profile_trace.sh --live HOST:PORT [duration_ms]
+#
+# The server answers 200 with the capture dir, or 409 if a window is
+# already open (single-capture latch).  For a *training* process, send
+# SIGUSR2 instead (`kill -USR2 <pid>`); the run opens a window of
+# profile_window_ms at the next log boundary.  See OBSERVABILITY.md.
+#
 # Usage: bash scripts/profile_trace.sh [outdir]
+#        bash scripts/profile_trace.sh --live HOST:PORT [duration_ms]
 set -u
+if [ "${1:-}" = "--live" ]; then
+  ADDR=${2:?usage: profile_trace.sh --live HOST:PORT [duration_ms]}
+  DUR=${3:-2000}
+  BODY=$(curl -s -X POST "http://$ADDR/profile?duration_ms=$DUR") || {
+    echo "live capture failed: server at $ADDR unreachable"; exit 1; }
+  echo "$BODY"
+  case "$BODY" in
+    *profile_dir*) echo "profiler window open for ${DUR} ms"; exit 0 ;;
+    *"in progress"*) echo "capture already in progress (409)"; exit 1 ;;
+    *) echo "live capture refused"; exit 1 ;;
+  esac
+fi
 OUT=${1:-/root/repo/runs/tpu_session_r3}
 cd "$(dirname "$0")/.."
 mkdir -p "$OUT"

@@ -310,6 +310,12 @@ def _sync_lint_targets():
             for f in sorted(os.listdir(sub_dir))
             if f.endswith(".py")
         )
+    # the observability modules added by ISSUE 9 run on the serve request
+    # path (tracectx, promtext) or inside loop-adjacent threads (slo,
+    # profwin), so they carry the same contract; the rest of telemetry/
+    # is exempt (exporters' attention dump is an offline boundary)
+    for mod in ("tracectx.py", "promtext.py", "slo.py", "profwin.py"):
+        targets.append(os.path.join(REPO, "sat_tpu", "telemetry", mod))
     return targets
 
 
@@ -345,6 +351,7 @@ def test_telemetry_core_is_jax_free():
         "assert 'jax' not in sys.modules\n"
         "from sat_tpu import telemetry\n"
         "from sat_tpu.telemetry import exporters, heartbeat, spans\n"
+        "from sat_tpu.telemetry import profwin, promtext, slo, tracectx\n"
         "stamp = telemetry.bench_stamp()\n"
         "assert 'jax' not in sys.modules, 'telemetry core pulled in jax'\n"
         "assert 'platform' not in stamp['device']\n"

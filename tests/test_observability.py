@@ -1,0 +1,844 @@
+"""Fleet-observability tests (docs/OBSERVABILITY.md, ISSUE 9).
+
+Pins the contracts of the request-scoped tracing / exposition / live
+profiling / SLO stack:
+
+* trace-id plumbing: inbound ``X-Request-Id`` honored (sanitized) and
+  echoed on EVERY reply — 200s, 400s, sheds; minted when absent;
+* ``access.jsonl``: one record per terminal reply with all five phase
+  timings, their sum bounded by the total; size-capped rotation;
+* the Chrome trace gains one lane per retained request (synthetic tid +
+  ``thread_name`` metadata + per-phase child spans);
+* ``GET /metrics`` renders Prometheus text format 0.0.4 that a minimal
+  in-test parser accepts, on both the caption server and the train-side
+  ``MetricsListener``;
+* ``POST /profile``: bounded capture into ``<tdir>/profiles/<ts>/``,
+  single-capture latch (second request → 409), hard duration cap;
+* the SLO engine: fast+slow burn windows, ok↔burning transitions into
+  ``slo.jsonl``, ``/healthz`` degrading with the objective named, and
+  ``scripts/check_slo.py`` turning the log into CI exit codes;
+* heartbeat payloads carry ``schema_version``; ``_percentiles_ms`` edge
+  cases (empty span, single sample, ring wraparound).
+
+The e2e half boots a real CaptionServer on a tiny trained model (same
+fixture recipe as tests/test_serve.py) — CPU, ephemeral port.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sat_tpu import runtime, telemetry
+from sat_tpu.data.vocabulary import Vocabulary
+from sat_tpu.serve.engine import ServeEngine, load_serving_state
+from sat_tpu.serve.server import CaptionServer, _percentiles_ms
+from sat_tpu.telemetry import (
+    SCHEMA_VERSION,
+    exporters,
+    heartbeat,
+    profwin,
+    promtext,
+    slo,
+    tracectx,
+)
+
+from tests.test_runtime import SMALL_MODEL
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# tracectx: ids, phase records, Perfetto lanes
+# ---------------------------------------------------------------------------
+
+
+class TestTraceIds:
+    def test_minted_id_is_16_hex(self):
+        rid = tracectx.ensure_id(None)
+        assert len(rid) == 16
+        int(rid, 16)  # raises if not hex
+
+    def test_inbound_id_honored_and_sanitized(self):
+        assert tracectx.ensure_id("abc-123") == "abc-123"
+        # header injection / whitespace stripped, length bounded
+        assert tracectx.ensure_id("  a b\r\nc!! ") == "abc"
+        assert len(tracectx.ensure_id("x" * 500)) == 128
+
+    def test_garbage_only_id_gets_minted_replacement(self):
+        rid = tracectx.ensure_id("\r\n\r\n")
+        assert len(rid) == 16
+        int(rid, 16)
+
+    def test_distinct_mints(self):
+        assert tracectx.ensure_id(None) != tracectx.ensure_id(None)
+
+
+class TestRequestTracer:
+    def test_finish_record_carries_all_five_phases(self, tmp_path):
+        path = str(tmp_path / "access.jsonl")
+        tracer = tracectx.RequestTracer(path=path)
+        trace = tracer.begin("req-1")
+        t0 = trace.t_start_ns
+        trace.mark("queue_wait", t0, 1_000_000)
+        trace.mark("dispatch", t0 + 1_000_000, 2_000_000)
+        rec = tracer.finish(trace, 200, 10_000_000, bucket=4)
+        assert rec["trace_id"] == "req-1"
+        assert rec["status"] == 200 and rec["bucket"] == 4
+        assert rec["total_ms"] == 10.0
+        phases = rec["phases"]
+        assert set(phases) == {f"{p}_ms" for p in tracectx.PHASES}
+        assert phases["queue_wait_ms"] == 1.0
+        assert phases["dispatch_ms"] == 2.0
+        assert phases["detok_ms"] == 0.0  # unmarked phases present as 0
+        # the line landed on disk verbatim
+        on_disk = json.loads(open(path).read().strip())
+        assert on_disk == rec
+
+    def test_negative_durations_clamp_to_zero(self):
+        trace = tracectx.RequestTrace("t")
+        trace.mark("drain", 0, -5)
+        assert trace.phase_ms()["drain_ms"] == 0.0
+
+    def test_retention_ring_is_bounded(self):
+        tracer = tracectx.RequestTracer(keep=4)
+        for i in range(10):
+            tracer.finish(tracer.begin(f"r{i}"), 200, 1)
+        kept = tracer.finished()
+        assert len(kept) == 4
+        assert kept[-1]["trace_id"] == "r9"
+
+    def test_trace_events_one_lane_per_request(self):
+        tracer = tracectx.RequestTracer()
+        trace = tracer.begin("lane-test")
+        trace.t_start_ns = 5_000_000
+        trace.mark("queue_wait", 5_000_000, 1_000_000)
+        trace.mark("dispatch", 6_000_000, 2_000_000)
+        tracer.finish(trace, 200, 4_000_000)
+        events = tracer.trace_events(anchor_ns=0, pid=7)
+        names = [e["name"] for e in events]
+        assert names == [
+            "thread_name", "request lane-test", "queue_wait", "dispatch",
+        ]
+        meta, parent, child, _ = events
+        assert meta["ph"] == "M"
+        assert meta["args"]["name"] == "request lane-test"
+        # all events share one synthetic lane, clear of real thread ids
+        assert len({e["tid"] for e in events}) == 1
+        assert parent["tid"] >= tracectx._LANE_BASE
+        assert parent["ph"] == "X" and parent["ts"] == 5_000.0
+        assert parent["dur"] == 4_000.0  # total_ms * 1e3
+        assert child["ts"] == 5_000.0 and child["dur"] == 1_000.0
+
+    def test_lanes_merge_into_chrome_trace(self, tmp_path):
+        tel = telemetry.Telemetry(capacity=64)
+        with tel.span("serve/request"):
+            pass
+        tracer = tracectx.RequestTracer()
+        tracer.finish(tracer.begin("merged"), 200, 1_000_000)
+        path = str(tmp_path / "trace.json")
+        exporters.export_chrome_trace(
+            tel, path,
+            extra_events=tracer.trace_events(tel.anchor_ns),
+        )
+        doc = json.load(open(path))
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "serve/request" in names  # process spans still there
+        assert "request merged" in names  # plus the request lane
+
+
+# ---------------------------------------------------------------------------
+# rotating sink (satellite: size-capped telemetry logs)
+# ---------------------------------------------------------------------------
+
+
+class TestRotatingAppend:
+    def test_append_creates_parents_and_newline(self, tmp_path):
+        path = str(tmp_path / "deep" / "log.jsonl")
+        assert exporters.rotating_append(path, '{"a": 1}')
+        assert open(path).read() == '{"a": 1}\n'
+
+    def test_rollover_at_cap(self, tmp_path):
+        path = str(tmp_path / "log.jsonl")
+        line = "x" * 100
+        cap = 350
+        for _ in range(8):
+            assert exporters.rotating_append(path, line, cap_bytes=cap)
+        # a single .1 generation, primary kept under the cap
+        assert os.path.exists(path + ".1")
+        assert os.path.getsize(path) <= cap
+        assert not os.path.exists(path + ".2")
+        # nothing was lost in the most recent generation pair
+        total = sum(
+            1 for p in (path, path + ".1") for _ in open(p)
+        )
+        assert total >= cap // len(line)
+
+    def test_failure_degrades_returns_false(self, tmp_path):
+        target = tmp_path / "is_a_dir"
+        target.mkdir()
+        tel = telemetry.Telemetry(capacity=64)
+        assert not exporters.rotating_append(str(target), "line", tel=tel)
+        assert tel.counters().get("telemetry/export_errors") == 1
+
+
+# ---------------------------------------------------------------------------
+# promtext: exposition + a minimal Prometheus text parser
+# ---------------------------------------------------------------------------
+
+
+def parse_prometheus(text):
+    """Minimal text-format 0.0.4 parser: {(metric, labels_str): value}.
+    Raises on any line that is neither a comment nor a valid sample."""
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value_part = line.rpartition(" ")
+        assert name_part, f"unparsable sample line: {line!r}"
+        value = float(value_part)  # raises on malformed values
+        if "{" in name_part:
+            metric, _, rest = name_part.partition("{")
+            assert rest.endswith("}"), f"unclosed labels: {line!r}"
+            labels = rest[:-1]
+        else:
+            metric, labels = name_part, ""
+        assert metric.replace("_", "").isalnum(), f"bad metric: {metric!r}"
+        samples[(metric, labels)] = value
+    return samples
+
+
+class TestPromText:
+    def test_render_families_and_values(self):
+        tel = telemetry.Telemetry(capacity=64)
+        tel.count("serve/completed", 3)
+        tel.gauge("serve/queue_depth", 2)
+        tel.record("serve/request", 0, 2_000_000_000)
+        text = promtext.render(tel, extra={"steps_per_s": 1.5, "run_id": "x"})
+        assert text.endswith("sat_up 1\n")
+        samples = parse_prometheus(text)
+        assert samples[("sat_counter_total", 'name="serve/completed"')] == 3
+        assert samples[("sat_gauge", 'name="serve/queue_depth"')] == 2
+        # numeric extra rides the gauge family; the string one is skipped
+        assert samples[("sat_gauge", 'name="steps_per_s"')] == 1.5
+        assert ("sat_gauge", 'name="run_id"') not in samples
+        assert samples[("sat_span_seconds_count", 'span="serve/request"')] == 1
+        assert samples[("sat_span_seconds_sum", 'span="serve/request"')] == 2.0
+        assert samples[("sat_up", "")] == 1
+
+    def test_label_escaping(self):
+        tel = telemetry.Telemetry(capacity=64)
+        tel.count('weird"name\\with\nstuff')
+        text = promtext.render(tel)
+        assert '\\"' in text and "\\\\" in text and "\\n" in text
+        # document still line-parses (the raw newline was escaped away)
+        parse_prometheus(text)
+
+    def test_metrics_listener_ephemeral_port(self):
+        tel = telemetry.Telemetry(capacity=64)
+        tel.count("train/steps", 5)
+        ml = promtext.MetricsListener(
+            "127.0.0.1", 0, tel, payload_fn=lambda: {"step": 12}
+        )
+        assert ml.start()
+        try:
+            assert ml.port > 0  # read back from the ephemeral bind
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ml.port}/metrics", timeout=10
+            ) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"] == promtext.CONTENT_TYPE
+                samples = parse_prometheus(r.read().decode())
+            assert samples[("sat_counter_total", 'name="train/steps"')] == 5
+            assert samples[("sat_gauge", 'name="step"')] == 12  # payload extra
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ml.port}/healthz", timeout=10
+            ) as r:
+                assert json.loads(r.read()) == {"step": 12}
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{ml.port}/nope", timeout=10
+                )
+            assert exc.value.code == 404
+        finally:
+            ml.stop()
+
+    def test_listener_bind_failure_degrades(self):
+        tel = telemetry.Telemetry(capacity=64)
+        ml = promtext.MetricsListener("127.0.0.1", 0, tel)
+        assert ml.start()
+        try:
+            clash = promtext.MetricsListener("127.0.0.1", ml.port, tel)
+            assert clash.start() is False  # warns, returns False, no raise
+        finally:
+            ml.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: windows, transitions, slo.jsonl, check_slo.py
+# ---------------------------------------------------------------------------
+
+
+def _fake_clocks():
+    """Deterministic mono+wall clocks advanced together by the test."""
+    state = {"ns": 0}
+
+    def advance(s):
+        state["ns"] += int(s * 1e9)
+
+    return state, advance, lambda: state["ns"], lambda: state["ns"] / 1e9
+
+
+class TestSLOEngine:
+    def test_objective_validation(self):
+        with pytest.raises(ValueError):
+            slo.Objective(name="x", kind="nope", target=1.0, source="s")
+        with pytest.raises(ValueError):
+            slo.Objective(
+                name="x", kind="latency_p99", target=0.0, source="s"
+            )
+
+    def test_latency_burn_cycle_and_transitions(self, tmp_path):
+        tel = telemetry.Telemetry(capacity=4096)
+        _, advance, clock_ns, wall = _fake_clocks()
+        path = str(tmp_path / "slo.jsonl")
+        eng = slo.SLOEngine(
+            tel,
+            [slo.Objective(
+                name="p99", kind="latency_p99", target=10.0,
+                source="serve/request",
+            )],
+            jsonl_path=path,
+            fast_s=2.0,
+            slow_s=4.0,
+            clock_ns=clock_ns,
+            wall_clock=wall,
+        )
+        # healthy traffic: 5 ms requests
+        for _ in range(6):
+            advance(0.2)
+            tel.record("serve/request", clock_ns(), 5_000_000)
+            eng.tick()
+        assert eng.burning() == []
+        assert tel.gauges().get("slo/p99_burn") == 0.5
+        # sustained violation: 50 ms requests fill BOTH windows
+        for _ in range(25):
+            advance(0.2)
+            tel.record("serve/request", clock_ns(), 50_000_000)
+            eng.tick()
+        assert eng.burning() == ["p99"]
+        assert tel.gauges().get("slo/p99_burning") == 1
+        assert tel.gauges().get("slo/burning_total") == 1
+        # recovery: healthy again until both windows forget the incident
+        for _ in range(30):
+            advance(0.2)
+            tel.record("serve/request", clock_ns(), 5_000_000)
+            eng.tick()
+        assert eng.burning() == []
+        events = [json.loads(l) for l in open(path)]
+        assert [e["event"] for e in events] == ["burning", "ok"]
+        assert all(e["name"] == "p99" for e in events)
+        assert all(e["schema_version"] == SCHEMA_VERSION for e in events)
+        assert events[0]["burn_fast"] >= 1.0
+
+    def test_min_events_guard(self):
+        """Fewer than MIN_EVENTS samples in a window is unmeasurable —
+        one or two outliers cannot page; the third violating sample can."""
+        tel = telemetry.Telemetry(capacity=4096)
+        _, advance, clock_ns, wall = _fake_clocks()
+        eng = slo.SLOEngine(
+            tel,
+            [slo.Objective(
+                name="p99", kind="latency_p99", target=10.0,
+                source="serve/request",
+            )],
+            fast_s=2.0, slow_s=4.0, clock_ns=clock_ns, wall_clock=wall,
+        )
+        for _ in range(slo.MIN_EVENTS - 1):
+            advance(0.2)
+            tel.record("serve/request", clock_ns(), 500_000_000)
+            eng.tick()
+        assert eng.burning() == []  # 2 samples: below the evidence bar
+        advance(0.2)
+        tel.record("serve/request", clock_ns(), 500_000_000)
+        eng.tick()
+        assert eng.burning() == ["p99"]  # 3rd sustained violation pages
+
+    def test_error_ratio_and_rate_floor(self):
+        tel = telemetry.Telemetry(capacity=256)
+        _, advance, clock_ns, wall = _fake_clocks()
+        eng = slo.SLOEngine(
+            tel,
+            [
+                slo.Objective(
+                    name="errors", kind="error_ratio", target=0.1,
+                    source="serve/http_5xx", denom="serve/http_requests",
+                ),
+                slo.Objective(
+                    name="rate", kind="rate_floor", target=100.0,
+                    source="train/step", scale=10.0,
+                ),
+            ],
+            fast_s=2.0, slow_s=4.0, clock_ns=clock_ns, wall_clock=wall,
+        )
+        step = 0
+        # healthy: no errors, 20 steps/s * scale 10 = 200 >= 100
+        for _ in range(30):
+            advance(0.2)
+            step += 4
+            tel.gauge("train/step", step)
+            tel.count("serve/http_requests", 5)
+            eng.tick()
+        assert eng.burning() == []
+        # degraded: half the requests 5xx, training stalled
+        for _ in range(30):
+            advance(0.2)
+            tel.gauge("train/step", step)  # flat = rate 0
+            tel.count("serve/http_requests", 4)
+            tel.count("serve/http_5xx", 2)
+            eng.tick()
+        assert eng.burning() == ["errors", "rate"]
+
+    def test_age_ceiling(self):
+        tel = telemetry.Telemetry(capacity=64)
+        _, advance, clock_ns, wall = _fake_clocks()
+        eng = slo.SLOEngine(
+            tel,
+            [slo.Objective(
+                name="ckpt", kind="age_ceiling", target=60.0,
+                source="ckpt/last_save_unix",
+            )],
+            fast_s=2.0, slow_s=4.0, clock_ns=clock_ns, wall_clock=wall,
+        )
+        eng.tick()  # gauge absent: unmeasurable, not burning
+        assert eng.burning() == []
+        tel.gauge("ckpt/last_save_unix", wall())
+        advance(30)
+        eng.tick()
+        assert eng.burning() == []  # 30 s old, ceiling 60
+        advance(90)
+        eng.tick()
+        assert eng.burning() == ["ckpt"]
+
+    def test_objectives_from_config_gated_by_targets(self):
+        from sat_tpu.config import Config
+
+        assert slo.objectives_from_config(Config(), "serve") == []
+        assert slo.objectives_from_config(Config(), "train") == []
+        config = Config(
+            slo_serve_p99_ms=250.0,
+            slo_error_ratio=0.05,
+            slo_captions_per_s=100.0,
+            slo_ckpt_age_s=900.0,
+        )
+        serve_names = [
+            o.name for o in slo.objectives_from_config(config, "serve")
+        ]
+        train_names = [
+            o.name for o in slo.objectives_from_config(config, "train")
+        ]
+        assert serve_names == ["serve_p99_ms", "error_ratio"]
+        assert train_names == ["captions_per_s", "ckpt_age_s"]
+
+    def test_config_validates_slo_knobs(self):
+        from sat_tpu.config import Config
+
+        with pytest.raises(ValueError):
+            Config(slo_error_ratio=2.0)
+        with pytest.raises(ValueError):
+            Config(slo_window_fast_s=300.0, slo_window_slow_s=60.0)
+        with pytest.raises(ValueError):
+            Config(metrics_port=-1)
+
+
+class TestCheckSLOScript:
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "check_slo.py"),
+             *argv],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+
+    def _write(self, tmp_path, records, name="slo.jsonl"):
+        path = tmp_path / name
+        path.write_text(
+            "".join(json.dumps(r) + "\n" for r in records)
+        )
+        return str(path)
+
+    def _rec(self, event, name="p99"):
+        return {
+            "schema_version": SCHEMA_VERSION, "name": name, "event": event,
+            "kind": "latency_p99", "target": 10.0, "measured_fast": 50.0,
+            "burn_fast": 5.0, "burn_slow": 5.0,
+        }
+
+    def test_empty_log_passes(self, tmp_path):
+        path = self._write(tmp_path, [])
+        proc = self._run(path)
+        assert proc.returncode == 0
+        assert "no transitions" in proc.stdout
+
+    def test_recovered_passes_default_fails_strict(self, tmp_path):
+        path = self._write(
+            tmp_path, [self._rec("burning"), self._rec("ok")]
+        )
+        assert self._run(path).returncode == 0
+        assert self._run(path, "--strict").returncode == 2
+
+    def test_ended_burning_fails(self, tmp_path):
+        path = self._write(tmp_path, [self._rec("burning")])
+        proc = self._run(path)
+        assert proc.returncode == 2
+        assert "p99" in proc.stderr
+
+    def test_schema_mismatch_refused_exit_3(self, tmp_path):
+        bad = self._rec("ok")
+        bad["schema_version"] = SCHEMA_VERSION + 99
+        path = self._write(tmp_path, [bad])
+        proc = self._run(path)
+        assert proc.returncode == 3
+        assert "REFUSED" in proc.stderr
+
+    def test_torn_line_tolerated(self, tmp_path):
+        path = self._write(tmp_path, [self._rec("ok")])
+        with open(path, "a") as f:
+            f.write('{"torn": ')
+        assert self._run(path).returncode == 0
+
+    def test_missing_file_exit_1(self, tmp_path):
+        assert self._run(str(tmp_path / "absent.jsonl")).returncode == 1
+
+
+# ---------------------------------------------------------------------------
+# profiler windows (unit: latch semantics; capture e2e below)
+# ---------------------------------------------------------------------------
+
+
+class TestProfileLatch:
+    def test_second_start_refused_then_released(self, tmp_path):
+        latch = profwin.ProfileLatch(str(tmp_path))
+        ok, out_dir = latch.start(duration_ms=200.0)
+        assert ok, out_dir
+        assert out_dir.startswith(os.path.join(str(tmp_path), "profiles"))
+        ok2, reason = latch.start(duration_ms=200.0)
+        assert not ok2 and "in progress" in reason
+        deadline = time.time() + 10.0
+        while latch.busy and time.time() < deadline:
+            time.sleep(0.02)
+        assert not latch.busy  # timer released the latch
+        assert latch.captures == 1
+        assert os.path.isdir(out_dir)
+
+    def test_stop_now_releases_early(self, tmp_path):
+        latch = profwin.ProfileLatch(str(tmp_path))
+        ok, _ = latch.start(duration_ms=profwin.HARD_CAP_MS)  # clamped max
+        assert ok
+        latch.stop_now()
+        assert not latch.busy
+        latch.stop_now()  # idempotent when idle
+
+    def test_signal_trigger_pops_once(self):
+        trig = profwin.SignalTrigger()
+        assert not trig.pop()
+        trig.fire()
+        assert trig.pop()
+        assert not trig.pop()  # latched, not level
+
+
+# ---------------------------------------------------------------------------
+# heartbeat schema + _percentiles_ms edges
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_payload_carries_schema_version(tmp_path):
+    tel = telemetry.Telemetry(capacity=64)
+    hb = heartbeat.Heartbeat(
+        str(tmp_path / "heartbeat.json"), 60.0, tel, static={"phase": "t"}
+    )
+    payload = hb.payload()
+    assert payload["schema_version"] == SCHEMA_VERSION
+    assert payload["phase"] == "t"
+    json.dumps(payload)  # must be a JSON document end to end
+
+
+class TestPercentilesEdges:
+    def test_empty_span_returns_none(self):
+        tel = telemetry.Telemetry(capacity=64)
+        assert _percentiles_ms(tel, "serve/never_recorded") is None
+
+    def test_single_sample(self):
+        tel = telemetry.Telemetry(capacity=64)
+        tel.record("serve/one", 0, 7_000_000)
+        p = _percentiles_ms(tel, "serve/one")
+        assert p["count"] == 1
+        assert p["p50"] == p["p95"] == p["p99"] == 7.0
+
+    def test_ring_wraparound_keeps_newest(self):
+        """More records than capacity: percentiles reflect the survivors
+        (the newest window), not a corrupted mixture."""
+        tel = telemetry.Telemetry(capacity=256)
+        for _ in range(300):
+            tel.record("serve/wrap", 0, 1_000_000)  # evicted era: 1 ms
+        for _ in range(300):
+            tel.record("serve/wrap", 0, 9_000_000)  # surviving era: 9 ms
+        p = _percentiles_ms(tel, "serve/wrap")
+        assert 0 < p["count"] <= 256
+        assert p["p50"] == p["p99"] == 9.0
+
+
+# ---------------------------------------------------------------------------
+# e2e: served model, tracing through the wire, /metrics, /profile, SLO burn
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def obs_served(coco_fixture, tmp_path_factory):
+    """Tiny trained model + warmed engine + a telemetry_dir of its own
+    (the observability artifacts — access.jsonl, slo.jsonl, profiles/ —
+    land somewhere this module can inspect)."""
+    root = tmp_path_factory.mktemp("obs_serve")
+    train_config = coco_fixture["config"].replace(
+        **SMALL_MODEL,
+        save_dir=os.path.join(str(root), "models"),
+        summary_dir=os.path.join(str(root), "summary"),
+    )
+    runtime.train(train_config)
+
+    config = train_config.replace(
+        phase="serve",
+        beam_size=2,
+        serve_buckets=(1, 4),
+        serve_max_batch=4,
+        serve_max_wait_ms=30.0,
+        serve_queue_depth=8,
+        heartbeat_interval=0.2,
+        telemetry_dir=os.path.join(str(root), "telemetry"),
+    )
+    tel = telemetry.enable(capacity=16384)
+    runtime._install_compile_listener()
+    vocabulary = Vocabulary(config.vocabulary_size, config.vocabulary_file)
+    state, _ = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+    yield {"config": config, "engine": engine, "tel": tel}
+    telemetry.disable()
+
+
+def _jpeg(obs_served):
+    d = obs_served["config"].eval_image_dir
+    f = sorted(os.listdir(d))[0]
+    return open(os.path.join(d, f), "rb").read()
+
+
+def _post(port, path, data, headers=None, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=data,
+        method="POST",
+        headers={"Content-Type": "image/jpeg", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _get(port, path, timeout=30):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        ) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_e2e_trace_id_phases_metrics_profile(obs_served, tmp_path):
+    config, engine = obs_served["config"], obs_served["engine"]
+    server = CaptionServer(config, engine, port=0).start()
+    try:
+        port = server.port
+        jpeg = _jpeg(obs_served)
+
+        # -- inbound id honored: header AND body echo it -----------------
+        status, headers, payload = _post(
+            port, "/caption", jpeg, headers={"X-Request-Id": "abc"}
+        )
+        assert status == 200
+        assert headers["X-Request-Id"] == "abc"
+        assert payload["request_id"] == "abc"
+        assert payload["captions"]
+
+        # no inbound id: one is minted and still echoed
+        status, headers, payload = _post(port, "/caption", jpeg)
+        assert status == 200
+        minted = headers["X-Request-Id"]
+        assert len(minted) == 16 and payload["request_id"] == minted
+
+        # -- the access log record: all five phases, sum bounded ---------
+        records = server.tracer.finished()
+        rec = next(r for r in records if r["trace_id"] == "abc")
+        assert rec["status"] == 200 and rec["bucket"] == 1
+        phases = rec["phases"]
+        assert set(phases) == {f"{p}_ms" for p in tracectx.PHASES}
+        # a real dispatched request timed real work
+        assert phases["dispatch_ms"] > 0.0
+        assert phases["drain_ms"] > 0.0
+        # disjoint sub-intervals: the sum never exceeds the total
+        assert sum(phases.values()) <= rec["total_ms"] + 1e-6
+        access = os.path.join(config.telemetry_dir, "access.jsonl")
+        on_disk = [json.loads(l) for l in open(access)]
+        assert any(r["trace_id"] == "abc" for r in on_disk)
+
+        # -- X-Request-Id echoes on error replies too (satellite b) ------
+        status, headers, payload = _post(
+            port, "/caption", b"not a jpeg",
+            headers={"X-Request-Id": "bad-input-1"},
+        )
+        assert status == 400
+        assert headers["X-Request-Id"] == "bad-input-1"
+        assert payload["request_id"] == "bad-input-1"
+        status, headers, _ = _get(port, "/nope")
+        assert status == 404 and "X-Request-Id" in headers
+
+        # -- Chrome trace carries the request lane ------------------------
+        trace_path = str(tmp_path / "trace.json")
+        assert server.export_trace(trace_path) == trace_path
+        doc = json.load(open(trace_path))
+        lane = [
+            e for e in doc["traceEvents"]
+            if e.get("args", {}).get("trace_id") == "abc"
+        ]
+        kinds = [e["name"] for e in lane]
+        assert "request abc" in kinds
+        assert {"queue_wait", "dispatch", "drain", "detok"} <= set(kinds)
+        tids = {e["tid"] for e in lane}
+        assert len(tids) == 1  # one lane per request
+        meta = [
+            e for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e.get("tid") in tids
+        ]
+        assert meta and meta[0]["args"]["name"] == "request abc"
+
+        # -- GET /metrics: content type + parses ---------------------------
+        status, headers, body = _get(port, "/metrics")
+        assert status == 200
+        assert headers["Content-Type"] == promtext.CONTENT_TYPE
+        samples = parse_prometheus(body.decode())
+        assert samples[
+            ("sat_counter_total", 'name="serve/http_requests"')
+        ] >= 3
+        assert (
+            samples[("sat_span_seconds_count", 'span="serve/request"')] >= 2
+        )
+        assert samples[("sat_up", "")] == 1
+        # heartbeat numerics ride in as gauges
+        assert samples[("sat_gauge", 'name="model_step"')] == engine.step
+
+        # -- POST /profile: capture window + 409 latch ---------------------
+        status, headers, payload = _post(
+            port, "/profile?duration_ms=300", b""
+        )
+        assert status == 200, payload
+        prof_dir = payload["profile_dir"]
+        assert prof_dir.startswith(
+            os.path.join(config.telemetry_dir, "profiles")
+        )
+        # a second capture while the window is open: 409, latch holds
+        status, headers, second = _post(
+            port, "/profile?duration_ms=300", b""
+        )
+        assert status == 409 and "in progress" in second["error"]
+        # run some traffic INSIDE the window so the trace has content
+        _post(port, "/caption", jpeg)
+        deadline = time.time() + 15.0
+        while server.profiles.busy and time.time() < deadline:
+            time.sleep(0.05)
+        assert not server.profiles.busy
+        # the capture produced a non-empty profile directory
+        captured = [
+            os.path.join(dirpath, f)
+            for dirpath, _, files in os.walk(prof_dir)
+            for f in files
+        ]
+        assert captured, f"profiler window wrote nothing under {prof_dir}"
+        status, headers, bad = _post(port, "/profile?duration_ms=abc", b"")
+        assert status == 400
+
+        # -- /stats grew the observability fields --------------------------
+        status, _, body = _get(port, "/stats")
+        stats = json.loads(body)
+        assert stats["profile_captures"] >= 1
+        assert "slo" in stats
+    finally:
+        server.shutdown()
+
+
+def test_e2e_slo_burn_degrades_health(obs_served, monkeypatch, tmp_path):
+    """Injected serve latency (SAT_FI_SLOW_SERVE_MS) violates a tight p99
+    objective: the SLO engine flips to burning, /healthz degrades with
+    the objective named, slo.jsonl records the transition, and
+    check_slo.py turns the log into a non-zero exit."""
+    engine = obs_served["engine"]
+    config = obs_served["config"].replace(
+        telemetry_dir=str(tmp_path / "slo_tel"),
+        slo_serve_p99_ms=5.0,       # every request will violate this
+        slo_window_fast_s=0.6,
+        slo_window_slow_s=1.2,
+    )
+    # the batcher captures its FaultPlan at construction: arm BEFORE
+    monkeypatch.setenv("SAT_FI_SLOW_SERVE_MS", "50")
+    server = CaptionServer(config, engine, port=0).start()
+    try:
+        port = server.port
+        jpeg = _jpeg(obs_served)
+        # enough traffic to fill both burn windows with violating p99s
+        deadline = time.time() + 30.0
+        burning = []
+        while time.time() < deadline:
+            status, _, _ = _post(port, "/caption", jpeg)
+            assert status == 200
+            burning = server.slo.burning()
+            if burning:
+                break
+        assert burning == ["serve_p99_ms"], "SLO never flipped to burning"
+
+        code, _, body = _get(port, "/healthz")
+        health = json.loads(body)
+        assert code == 503
+        assert health["status"] == "degraded"
+        assert health["slo_burning"] == ["serve_p99_ms"]
+
+        slo_log = os.path.join(config.telemetry_dir, "slo.jsonl")
+        events = [json.loads(l) for l in open(slo_log)]
+        assert any(
+            e["event"] == "burning" and e["name"] == "serve_p99_ms"
+            for e in events
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "check_slo.py"),
+             slo_log],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "serve_p99_ms" in proc.stderr
+
+        # the injected latency landed in the drain phase of the access log
+        recent = server.tracer.finished()[-1]
+        assert recent["phases"]["drain_ms"] >= 50.0
+    finally:
+        monkeypatch.delenv("SAT_FI_SLOW_SERVE_MS", raising=False)
+        server.shutdown()
+    # recovery sanity: with the fault gone and fresh windows, a new
+    # engine-backed server starts un-degraded (state is per-server)
+    clean = CaptionServer(obs_served["config"], engine, port=0)
+    assert clean.slo.burning() == []
