@@ -162,6 +162,28 @@ def build_config(argv: Optional[List[str]] = None):
              "load in Perfetto or chrome://tracing",
     )
     p.add_argument(
+        "--fleet_telemetry", action="store_true",
+        help="cross-host fleet plane (docs/OBSERVABILITY.md): each "
+             "process writes a heartbeat_p<i>.json sidecar at the log "
+             "boundary and process 0 merges them into fleet.json with "
+             "per-host rows, skew ratios, and a straggler verdict; "
+             "implies --telemetry (shared dir via --set fleet_dir=...)",
+    )
+    p.add_argument(
+        "--blackbox", action="store_true",
+        help="black-box flight recorder (docs/OBSERVABILITY.md): journal "
+             "recent counters/gauges/events to a bounded on-disk ring and "
+             "dump a postmortem_<run_id>/ bundle on abnormal exits "
+             "(watchdog 86, data corruption 87, sentinel trips, uncaught "
+             "exceptions); implies --telemetry",
+    )
+    p.add_argument(
+        "--straggler_factor", type=float, default=None, metavar="X",
+        help="fleet straggler threshold: name the worst host when its "
+             "step-time p95 exceeds the fleet median by this factor "
+             "(default 2.0)",
+    )
+    p.add_argument(
         "--port", type=int, default=None, metavar="PORT",
         help="serve phase: HTTP listen port (default Config.serve_port; "
              "0 picks an ephemeral port)",
@@ -253,6 +275,13 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(io_retries=args.io_retries)
     if args.telemetry:
         config = config.replace(telemetry=True)
+    if args.fleet_telemetry:
+        # both ride the span recorder, so they imply the base layer
+        config = config.replace(fleet_telemetry=True, telemetry=True)
+    if args.blackbox:
+        config = config.replace(blackbox=True, telemetry=True)
+    if args.straggler_factor is not None:
+        config = config.replace(straggler_factor=args.straggler_factor)
     if args.heartbeat_interval is not None:
         config = config.replace(heartbeat_interval=args.heartbeat_interval)
     if args.metrics_port is not None:
@@ -298,6 +327,17 @@ def build_config(argv: Optional[List[str]] = None):
         "repair_shards": args.repair_shards,
     }
     return config, cli
+
+
+def _postmortem(reason: str, exit_code: "Optional[int]" = None, **fields) -> None:
+    """Best-effort black-box bundle on an abnormal CLI exit path — a
+    no-op unless the run installed a recorder (``--blackbox``)."""
+    try:
+        from .telemetry import blackbox as _blackbox
+
+        _blackbox.dump(reason, exit_code=exit_code, **fields)
+    except Exception:
+        pass  # the process is already dying; forensics must not mask why
 
 
 def _arm_device_watchdog() -> "callable":
@@ -426,19 +466,29 @@ def main(argv: Optional[List[str]] = None) -> int:
             # — warn + non-zero exit instead of a swallowed queue failure
             # or a bare traceback (docs/RESILIENCE.md)
             print(f"sat_tpu: WARNING: {e}", file=sys.stderr, flush=True)
+            _postmortem("checkpoint_write_failed", 1, error=str(e))
             return 1
         except SimulatedPreemption as e:
             # injected die-at-step-k: behave like the preempted process
             # the injection simulates (non-zero exit; supervisor relaunches
             # with --load)
             print(f"sat_tpu: {e}", file=sys.stderr, flush=True)
+            _postmortem("simulated_preemption", 1, error=str(e))
             return 1
         except SystemicCorruption as e:
             # the quarantine ceiling tripped: the input data is rotten,
             # not the process — a distinct exit code the supervisor
             # refuses to restart (a rerun re-reads the same rot)
             print(f"sat_tpu: FATAL: {e}", file=sys.stderr, flush=True)
+            _postmortem(
+                "systemic_corruption", DATA_CORRUPTION_EXIT_CODE, error=str(e)
+            )
             return DATA_CORRUPTION_EXIT_CODE
+        except Exception as e:
+            # any other crash: leave forensics behind, then fail loudly
+            # with the original traceback
+            _postmortem("uncaught_exception", None, error=repr(e))
+            raise
         # graceful SIGTERM/SIGINT: train() drained and returned normally —
         # fall through to exit 0 so the supervisor relaunches into --load
     elif config.phase == "serve":

@@ -172,6 +172,24 @@ class Config:
     slo_error_ratio: float = 0.0       # serve: 5xx / all requests
     slo_captions_per_s: float = 0.0    # train: step rate x batch_size floor
     slo_ckpt_age_s: float = 0.0        # train: newest-checkpoint age ceiling
+    # ---- fleet plane + black box (telemetry/fleet.py, blackbox.py; ----
+    # ---- docs/OBSERVABILITY.md "Fleet & Postmortem") ----
+    # cross-host aggregation at the log boundary: per-process
+    # heartbeat_p<i>.json sidecars merged by process 0 into fleet.json
+    # with skew ratios and a straggler verdict (requires telemetry)
+    fleet_telemetry: bool = False
+    # shared directory the fleet's sidecars and fleet.json live in ("" =
+    # this process's telemetry_dir; multi-host launchers point every
+    # process at one directory on common storage)
+    fleet_dir: str = ""
+    # a host is named the straggler when its step-time p95 exceeds the
+    # fleet median by this factor (must be >= 1)
+    straggler_factor: float = 2.0
+    # black-box flight recorder: bounded on-disk ring journaling recent
+    # counters/gauges/events; abnormal exits (watchdog 86, corruption 87,
+    # sentinel trips, uncaught exceptions) dump a postmortem_<run_id>/
+    # bundle summarized by scripts/analyze_postmortem.py
+    blackbox: bool = False
 
     # ---- online serving (docs/SERVING.md; no reference equivalent) ----
     # Request-driven captioning service (sat_tpu/serve): a stdlib HTTP
@@ -430,6 +448,11 @@ class Config:
                 f"Config.supervise_max_restarts={self.supervise_max_restarts}: "
                 "must be >= 0"
             )
+        if self.straggler_factor < 1:
+            raise ValueError(
+                f"Config.straggler_factor={self.straggler_factor}: must be "
+                ">= 1 (a host at the fleet median is not a straggler)"
+            )
 
     def replace(self, **kw: Any) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -477,7 +500,7 @@ class Config:
     LOG_PATH_FIELDS = (
         "save_dir", "summary_dir", "profile_dir", "eval_result_dir",
         "eval_result_file", "test_result_dir", "test_result_file",
-        "telemetry_dir", "trace_export",
+        "telemetry_dir", "trace_export", "fleet_dir",
     )
 
     def apply_env_paths(self) -> "Config":

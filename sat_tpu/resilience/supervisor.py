@@ -68,10 +68,22 @@ def _strip_supervise(argv: List[str]) -> List[str]:
 
 
 def _describe(rc: int) -> str:
+    # The child writes postmortem_<run_id>/ under its telemetry dir on
+    # both abnormal codes when launched with --blackbox; the supervisor
+    # is a jax-free parent that never resolves that path, so it names
+    # the analyzer instead of the bundle.
     if rc == WATCHDOG_EXIT_CODE:
-        return "watchdog abort (wedged run; LAST_GOOD landed)"
+        return (
+            "watchdog abort (wedged run; LAST_GOOD landed); with "
+            "--blackbox a postmortem_<run_id>/ bundle is in the telemetry "
+            "dir — summarize with scripts/analyze_postmortem.py"
+        )
     if rc == DATA_CORRUPTION_EXIT_CODE:
-        return "systemic data corruption (quarantine ceiling)"
+        return (
+            "systemic data corruption (quarantine ceiling); with "
+            "--blackbox see postmortem_<run_id>/ via "
+            "scripts/analyze_postmortem.py"
+        )
     if rc < 0:
         try:
             return f"killed by {signal.Signals(-rc).name}"

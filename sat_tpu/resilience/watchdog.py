@@ -226,6 +226,7 @@ class Watchdog:
                 flush=True,
             )
             self._run_pre_abort()
+            self._postmortem(name, over)
             self._abort(WATCHDOG_EXIT_CODE)
 
     # -- escalation rungs --------------------------------------------------
@@ -285,6 +286,51 @@ class Watchdog:
         if not done.wait(timeout=max(self.grace_s, 2.0)):
             print(
                 "sat_tpu watchdog: pre-abort hook wedged too — aborting anyway",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    def _postmortem(self, name: str, over: float) -> None:
+        """Rung 3 epilogue: black-box bundle BEFORE ``os._exit`` (atexit
+        never runs on the abort path, so this is the only window).  Same
+        bounded-helper-thread discipline as the pre-abort hook — the dump
+        is pure host file IO, but a dead network mount must not turn the
+        abort into a second wedge.  No-op unless the run installed a
+        recorder (``--blackbox``)."""
+        done = threading.Event()
+
+        def _run():
+            try:
+                from ..telemetry import blackbox
+
+                bb = blackbox.installed()
+                if bb is not None:
+                    bb.event(
+                        "watchdog_abort", phase=name, overdue_s=round(over, 1)
+                    )
+                blackbox.dump(
+                    "watchdog_wedge",
+                    exit_code=WATCHDOG_EXIT_CODE,
+                    phase=name,
+                    overdue_s=round(over, 1),
+                    deadline_s=self.deadlines.get(name),
+                )
+            except Exception as e:
+                print(
+                    f"sat_tpu watchdog: postmortem dump failed: {e!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            finally:
+                done.set()
+
+        t = threading.Thread(
+            target=_run, name="sat-watchdog-postmortem", daemon=True
+        )
+        t.start()
+        if not done.wait(timeout=max(self.grace_s, 2.0)):
+            print(
+                "sat_tpu watchdog: postmortem dump wedged — aborting anyway",
                 file=sys.stderr,
                 flush=True,
             )

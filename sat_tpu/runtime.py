@@ -370,6 +370,8 @@ def _device_static() -> dict:
     static = {
         "backend": jax.default_backend(),
         "num_devices": jax.device_count(),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
     }
     try:
         d0 = jax.local_devices()[0]
@@ -378,6 +380,24 @@ def _device_static() -> dict:
     except Exception:
         pass
     return static
+
+
+def _fleet_gather(vec):
+    """Collective transport for ``FleetPlane.tick``: all-gather one ~6
+    float64 host vector across processes.  The fleet module is jax-free,
+    so the runtime injects this; any failure (no distributed init, mixed
+    topologies mid-teardown) returns None and the plane falls back to
+    reading sidecar files.  Single-process runs skip the collective
+    entirely — the sidecar path is already exact."""
+    if jax.process_count() == 1:
+        return None
+    try:
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.process_allgather(vec)
+        return np.asarray(out, dtype=np.float64)  # sync-ok: ~6 host scalars/process at the log boundary
+    except Exception:
+        return None
 
 
 def _device_memory_sampler():
@@ -584,6 +604,10 @@ def train(
     # when telemetry is on; SIGUSR2 latches a flag the log boundary drains
     profile_trigger = None
     profile_latch = None
+    # fleet telemetry plane + black-box flight recorder (docs/
+    # OBSERVABILITY.md "Fleet & Postmortem"): built below when configured
+    fleet_plane = None
+    bb = None
     # the ExitStack drains the async writer LAST (after SummaryWriter
     # closes), on success and on exception alike — queued checkpoint
     # writes survive an interrupt and worker failures surface
@@ -657,6 +681,47 @@ def train(
             profile_trigger = SignalTrigger()
             if hasattr(_signal, "SIGUSR2"):
                 profile_trigger.install(_signal.SIGUSR2)
+            # fleet plane (telemetry/fleet.py): every process writes a
+            # heartbeat_p<i>.json sidecar at the log boundary; process 0
+            # merges the fleet view into fleet.json + fleet/* gauges.
+            # finish() is registered so the terminal step is recorded
+            # even when the loop dies between boundaries.
+            if config.fleet_telemetry:
+                from .telemetry.fleet import FleetPlane
+
+                fleet_dir = config.fleet_dir or _telemetry_dir(config)
+                fleet_plane = FleetPlane(
+                    fleet_dir,
+                    jax.process_index(),
+                    jax.process_count(),
+                    tel,
+                    straggler_factor=config.straggler_factor,
+                    history_cap_bytes=int(config.telemetry_log_cap_mb * 1e6),
+                )
+                _stack.callback(fleet_plane.finish)
+        # black-box flight recorder (telemetry/blackbox.py): bounded
+        # on-disk ring journaling recent state; abnormal exits (watchdog
+        # 86, corruption 87, sentinel trip, uncaught exception, SIGTERM
+        # mid-checkpoint) dump a postmortem bundle from it.  The ExitStack
+        # runs the finalizer chain on clean teardown; the atexit hook
+        # covers paths that unwind without reaching it.
+        if config.blackbox:
+            from .resilience.quarantine import ledger_path_for
+            from .telemetry import blackbox as _blackbox
+
+            _bb_tdir = _telemetry_dir(config)
+            bb = _blackbox.BlackBox(os.path.join(_bb_tdir, "blackbox"), tel)
+            _blackbox.install(
+                bb,
+                telemetry_dir=_bb_tdir,
+                fleet_dir=(
+                    fleet_plane.fleet_dir if fleet_plane is not None else ""
+                ),
+                config_snapshot=config.to_dict(),
+                quarantine_ledger=ledger_path_for(config),
+            )
+            _stack.callback(_blackbox.run_finalizers)
+            bb.event("train_start", step=step)
         if async_writer:
             _stack.callback(async_writer.close)
         if config.watchdog_interval > 0:
@@ -795,7 +860,29 @@ def train(
                                     file=sys.stderr,
                                     flush=True,
                                 )
+                        # fleet tick: every process writes its sidecar
+                        # (and joins the gather when available); only
+                        # process 0 aggregates.  Black-box journal rides
+                        # the same boundary — both are pure host IO.
+                        if fleet_plane is not None:
+                            with tel.span("fleet/tick"):
+                                fleet_plane.tick(step, gather_fn=_fleet_gather)
+                        if bb is not None:
+                            bb.journal(step)
                         if sentinel.check(step, host) == "rollback":
+                            if bb is not None:
+                                from .telemetry import blackbox as _bbx
+
+                                bb.event(
+                                    "anomaly_rollback",
+                                    step=step,
+                                    reason=sentinel.last_reason,
+                                )
+                                _bbx.dump(
+                                    "anomaly_rollback",
+                                    step=step,
+                                    reason_detail=sentinel.last_reason,
+                                )
                             rollback = True
                             break
                     if (
@@ -861,6 +948,22 @@ def train(
                 file=sys.stderr,
                 flush=True,
             )
+            if bb is not None:
+                # the stop raced the final checkpoint (defer() held the
+                # force-kill window open) — leave a bundle so a later
+                # "did the tail land?" question has an answer
+                from .telemetry import blackbox as _bbx
+
+                bb.event(
+                    "sigterm_stop", step=step, signal=shutdown.signal_name
+                )
+                _bbx.dump(
+                    "sigterm_during_checkpoint",
+                    exit_code=0,
+                    step=step,
+                    signal=shutdown.signal_name,
+                    final_checkpoint=final_path or "",
+                )
     # the writer is drained here; the final save must actually be on disk
     # and restorable before train() reports success (a lost final
     # checkpoint silently discards the training tail)
@@ -1133,6 +1236,23 @@ def decode_dataset(
     # batch (the drain of batch n overlaps batch n+1's device beam search
     # — the breakdown shows whether the host decode keeps up)
     tel = _telemetry_begin(config)
+    # black-box flight recorder for decode (same contract as train's):
+    # journal per batch so an uncaught exception mid-eval still leaves a
+    # postmortem bundle behind via the CLI's exception handler
+    dec_bb = None
+    if config.blackbox:
+        from .resilience.quarantine import ledger_path_for
+        from .telemetry import blackbox as _blackbox
+
+        _dec_tdir = _telemetry_dir(config)
+        dec_bb = _blackbox.BlackBox(os.path.join(_dec_tdir, "blackbox"), tel)
+        _blackbox.install(
+            bb=dec_bb,
+            telemetry_dir=_dec_tdir,
+            config_snapshot=config.to_dict(),
+            quarantine_ledger=ledger_path_for(config),
+        )
+        dec_bb.event("decode_start", batches=dataset.num_batches)
     try:
         with ProfilerWindow(config, max_start=dataset.num_batches - 1) as prof:
             # per-batch visibility during decode (reference
@@ -1157,10 +1277,16 @@ def decode_dataset(
                 now = time.perf_counter_ns()
                 tel.record("decode/batch", batch_t0, now - batch_t0)
                 batch_t0 = now
+                if dec_bb is not None:
+                    dec_bb.journal(b)
         if prev is not None:
             with tel.span("decode/drain"):
                 drain(*prev)
     finally:
+        if dec_bb is not None:
+            from .telemetry import blackbox as _blackbox
+
+            _blackbox.run_finalizers()
         if tel.enabled:
             _telemetry_finish(tel, config, "decode")
     return results

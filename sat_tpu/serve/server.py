@@ -465,7 +465,11 @@ class CaptionServer:
             self._tel,
             path,
             extra_events=self.tracer.trace_events(
-                getattr(self._tel, "anchor_ns", 0), pid=os.getpid()
+                getattr(self._tel, "anchor_ns", 0),
+                # same lane convention as the host spans (exporters
+                # .chrome_trace): pid = process_index, so request lanes
+                # land in this host's process group after a fleet merge
+                pid=telemetry.process_identity()[0],
             ),
         )
 
@@ -584,6 +588,20 @@ def serve(config: Config, model_file: Optional[str] = None) -> int:
     )
     engine.warmup()
     server = CaptionServer(config, engine)
+    # flight recorder (telemetry/blackbox.py): journal serve state so an
+    # abnormal exit leaves a postmortem bundle like a training run's
+    bb = None
+    if config.blackbox:
+        from ..telemetry import blackbox as _blackbox
+
+        tdir = config.telemetry_dir or os.path.join(
+            config.summary_dir, "telemetry"
+        )
+        bb = _blackbox.BlackBox(os.path.join(tdir, "blackbox"), tel)
+        _blackbox.install(
+            bb, telemetry_dir=tdir, config_snapshot=config.to_dict()
+        )
+        bb.event("serve_start", port=server.port, model_step=engine.step)
     server.start()
     print(
         f"sat_tpu: captioning server listening on "
@@ -593,6 +611,16 @@ def serve(config: Config, model_file: Optional[str] = None) -> int:
         file=sys.stderr,
         flush=True,
     )
-    server.serve_until_shutdown()
+    try:
+        server.serve_until_shutdown()
+    except Exception as e:
+        if bb is not None:
+            from ..telemetry import blackbox as _blackbox
+
+            bb.event("uncaught_exception", error=repr(e))
+            _blackbox.dump("uncaught_exception", error=repr(e))
+        raise
+    if bb is not None:
+        bb.event("serve_drained")
     print("sat_tpu: serve drained cleanly", file=sys.stderr, flush=True)
     return 0

@@ -48,6 +48,25 @@ def run_id() -> str:
     return RUN_ID
 
 
+def process_identity() -> tuple:
+    """(process_index, process_count) for multi-host artifact stamping.
+
+    Same contract as :func:`bench_stamp`: never imports jax — the facts
+    are read via ``sys.modules`` only when the caller already initialized
+    a backend, and a single-process / host-only caller gets (0, 1).  The
+    fleet plane (telemetry/fleet.py), heartbeat.json, and bench rows all
+    stamp through here so cross-host artifacts agree on who wrote them."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index()), int(jax.process_count())
+        except Exception:
+            pass
+    return 0, 1
+
+
 def bench_stamp() -> dict:
     """Provenance stamp shared by every ``scripts/bench_*.py`` JSON output
     and ``compile_report.json``: artifact schema version, git SHA, and a
@@ -92,10 +111,13 @@ def bench_stamp() -> dict:
             )
         except Exception:
             pass
+    process_index, process_count = process_identity()
     return {
         "schema_version": SCHEMA_VERSION,
         "git_sha": sha,
         "run_id": RUN_ID,
         "stamp_unix": round(time.time(), 3),
+        "process_index": process_index,
+        "process_count": process_count,
         "device": device,
     }

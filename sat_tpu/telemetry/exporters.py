@@ -30,7 +30,7 @@ from typing import Dict, Iterable, List, Optional
 import numpy as np
 
 from ..utils.fileio import atomic_write
-from . import run_id
+from . import process_identity, run_id
 
 
 # ---------------------------------------------------------------------------
@@ -40,8 +40,9 @@ from . import run_id
 
 def chrome_trace(
     tel,
-    process_name: str = "sat_tpu host",
+    process_name: Optional[str] = None,
     extra_events: Optional[List[Dict]] = None,
+    pid: Optional[int] = None,
 ) -> Dict:
     """The trace-event document for ``tel``'s retained span window.
 
@@ -50,9 +51,23 @@ def chrome_trace(
     with ``metrics.jsonl``'s wall-clock stamps.  ``extra_events`` are
     pre-built trace events appended verbatim — the request lanes from
     ``tracectx.RequestTracer.trace_events`` ride in through here.
+
+    The trace ``pid`` defaults to the run's **process_index** (not the OS
+    pid): per-host traces from one multi-host run then occupy distinct,
+    stable lanes, and ``scripts/merge_traces.py`` can concatenate them
+    into one Perfetto timeline with a lane per host.  The OS pid still
+    rides in ``otherData``.
     """
     names, ids, t0s, durs, tids = tel.spans_snapshot()
-    pid = os.getpid()
+    process_index, process_count = process_identity()
+    if pid is None:
+        pid = process_index
+    if process_name is None:
+        process_name = (
+            f"sat_tpu host p{process_index}"
+            if process_count > 1
+            else "sat_tpu host"
+        )
     events: List[Dict] = [
         {
             "name": "process_name",
@@ -82,6 +97,9 @@ def chrome_trace(
         "otherData": {
             "run_id": run_id(),
             "anchor_unix": tel.anchor_unix,
+            "os_pid": os.getpid(),
+            "process_index": process_index,
+            "process_count": process_count,
             "counters": tel.counters(),
             "gauges": tel.gauges(),
         },

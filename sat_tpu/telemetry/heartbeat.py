@@ -31,7 +31,7 @@ import time
 from typing import Dict, Optional
 
 from ..utils.fileio import atomic_write
-from . import SCHEMA_VERSION, run_id
+from . import SCHEMA_VERSION, process_identity, run_id
 
 
 def _rss_bytes() -> int:
@@ -95,6 +95,10 @@ class Heartbeat:
         if step is not None:
             self._prev = (now, step)
         last_save = gauges.get("ckpt/last_save_unix")
+        # multi-host identity (telemetry.process_identity — jax-free, (0,1)
+        # for single-process runs): N heartbeat files on shared storage
+        # must say which host wrote each
+        process_index, process_count = process_identity()
         payload = {
             # consumers get the same contract check_regression gives bench
             # rows: refuse payloads whose schema they don't understand
@@ -102,6 +106,8 @@ class Heartbeat:
             "run_id": run_id(),
             "seq": self._seq,
             "pid": os.getpid(),
+            "process_index": process_index,
+            "process_count": process_count,
             "time_unix": round(now, 3),
             "interval_s": self.interval_s,
             "step": int(step) if step is not None else None,
@@ -167,6 +173,16 @@ class Heartbeat:
         }
         if slo:
             payload["slo"] = slo
+        # fleet aggregate (telemetry.fleet): hosts reporting, step-p95
+        # skew, straggler index — process 0's heartbeat answers "which
+        # host is slow" without opening fleet.json
+        fleet = {
+            k[len("fleet/"):]: v
+            for k, v in gauges.items()
+            if k.startswith("fleet/")
+        }
+        if fleet:
+            payload["fleet"] = fleet
         if self._sampler is not None:
             try:
                 payload.update(self._sampler() or {})

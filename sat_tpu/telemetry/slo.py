@@ -26,6 +26,11 @@ Four objective kinds cover the fleet contract (docs/OBSERVABILITY.md):
 ``age_ceiling``
     now_unix − gauge value (e.g. ``ckpt/last_save_unix``); burn =
     measured / target.  Instantaneous — both windows read the same age.
+``gauge_ceiling``
+    the gauge value itself vs target (e.g. ``fleet/step_p95_skew`` vs
+    the straggler factor); burn = measured / target.  Instantaneous,
+    like ``age_ceiling`` but without the now−stamp subtraction — for
+    signals that are already a ratio or level, not a timestamp.
 
 Outputs, all riding existing carriers: ``slo/*`` gauges (picked up by
 heartbeat and /metrics), ok↔burning transition records appended to
@@ -49,7 +54,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from . import SCHEMA_VERSION, run_id
 
-KINDS = ("latency_p99", "error_ratio", "rate_floor", "age_ceiling")
+KINDS = (
+    "latency_p99",
+    "error_ratio",
+    "rate_floor",
+    "age_ceiling",
+    "gauge_ceiling",
+)
 
 # an objective only evaluates once its window holds this many events
 # (latency/error kinds) — one outlier must not page
@@ -186,6 +197,11 @@ class SLOEngine:
                 return None, None
             age = max(0.0, self._wall() - stamp)
             return age, age / obj.target
+        if obj.kind == "gauge_ceiling":
+            value = self._tel.gauges().get(obj.source)
+            if value is None:
+                return None, None
+            return float(value), float(value) / obj.target  # sync-ok: host gauge scalar
         return None, None
 
     # -- evaluation --------------------------------------------------------
@@ -342,6 +358,18 @@ def objectives_from_config(config, phase: str) -> List[Objective]:
                     kind="age_ceiling",
                     target=config.slo_ckpt_age_s,
                     source="ckpt/last_save_unix",
+                )
+            )
+        if config.fleet_telemetry:
+            # the fleet plane publishes worst-host-p95 / fleet-median as
+            # fleet/step_p95_skew; sustained skew at/above the straggler
+            # factor is exactly the verdict condition, so it pages too
+            out.append(
+                Objective(
+                    name="fleet_step_skew",
+                    kind="gauge_ceiling",
+                    target=config.straggler_factor,
+                    source="fleet/step_p95_skew",
                 )
             )
     return out

@@ -458,6 +458,93 @@ def quarantine_ceiling(ctx: Ctx):
     return {}
 
 
+@scenario
+def fleet_straggler(ctx: Ctx):
+    """ISSUE 10 acceptance, half 1: SAT_FI_SLOW_STEP_MS on one host of a
+    simulated fleet.  Two fast peer sidecars are pre-seeded into the
+    shared fleet_dir, the one real process runs slowed with
+    --fleet_telemetry, and the merged fleet.json must report all three
+    hosts and name the real (slow) process 0 as the straggler."""
+    fleet_dir = os.path.join(ctx.root, "fleet_dir")
+    os.makedirs(fleet_dir, exist_ok=True)
+    for p in (1, 2):
+        with open(os.path.join(fleet_dir, f"heartbeat_p{p}.json"), "w") as f:
+            json.dump({
+                "process_index": p, "process_count": 3, "host": f"fast{p}",
+                "pid": 1000 + p, "step": 6, "time_unix": time.time(),
+                "step_p50_ms": 4.0, "step_p95_ms": 5.0, "data_wait_ms": 0.5,
+                "dispatch_ms": 1.0, "rss_mb": 256.0, "quarantined": 0.0,
+            }, f)
+    cfg = ctx.cfg("fleet", fleet_telemetry=True, fleet_dir=fleet_dir,
+                  straggler_factor=1.5)
+    proc = ctx.launch(cfg, env={"SAT_FI_SLOW_STEP_MS": "50"}, name="fleet")
+    _check_clean(proc, "fleet straggler run")
+    with open(os.path.join(fleet_dir, "fleet.json")) as f:
+        doc = json.load(f)
+    check(doc.get("hosts_reporting") == 3,
+          f"fleet.json merged {doc.get('hosts_reporting')} hosts, not 3")
+    verdict = doc.get("straggler", {})
+    check(verdict.get("verdict") is True,
+          f"no straggler verdict despite a 50ms/step host: {verdict}")
+    check(verdict.get("process_index") == 0,
+          f"straggler verdict names p{verdict.get('process_index')}, "
+          "expected the slowed p0")
+    hb = _heartbeat(cfg)
+    check(hb.get("fleet", {}).get("straggler_index") == 0,
+          f"heartbeat fleet/* gauges missing the verdict: {hb.get('fleet')}")
+    check(hb.get("process_index") == 0 and hb.get("process_count") == 1,
+          "heartbeat lacks process identity stamps")
+    return {"skew": verdict.get("skew")}
+
+
+@scenario
+def wedge_postmortem(ctx: Ctx):
+    """ISSUE 10 acceptance, half 2: a wedge -> exit 86 run with
+    --blackbox leaves a complete postmortem bundle, and one
+    analyze_postmortem.py command identifies the wedged phase."""
+    import glob as _glob
+
+    cfg = ctx.cfg("wedge_pm", **CHAOS_TIMINGS, blackbox=True)
+    proc = ctx.launch(cfg, env={"SAT_FI_WEDGE_AT_STEP": "5"},
+                      name="wedge_pm")
+    check(proc.returncode == WATCHDOG_EXIT_CODE,
+          f"rc {proc.returncode} != {WATCHDOG_EXIT_CODE}\n"
+          f"{proc.stdout}\n{proc.stderr}")
+    tdir = os.path.join(cfg.summary_dir, "telemetry")
+    bundles = _glob.glob(os.path.join(tdir, "postmortem_*"))
+    check(bundles, f"watchdog abort left no postmortem bundle under {tdir}")
+    bundle = max(bundles, key=os.path.getmtime)
+    with open(os.path.join(bundle, "manifest.json")) as f:
+        manifest = json.load(f)
+    check(manifest.get("reason") == "watchdog_wedge",
+          f"manifest reason {manifest.get('reason')}")
+    check(manifest.get("exit_code") == WATCHDOG_EXIT_CODE,
+          f"manifest exit_code {manifest.get('exit_code')}")
+    for name in ("spans_tail.json", "state.json", "watchdog_stacks.txt",
+                 "heartbeat.json", "config.json"):
+        check(os.path.exists(os.path.join(bundle, name)),
+              f"bundle incomplete: {name} missing "
+              f"(has {sorted(os.listdir(bundle))})")
+    check(_glob.glob(os.path.join(bundle, "blackbox", "seg_*.jsonl")),
+          "bundle has no black-box ring segments")
+    analyzer = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "analyze_postmortem.py"),
+         bundle, "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    check(analyzer.returncode == 0,
+          f"analyze_postmortem rc {analyzer.returncode}: {analyzer.stderr}")
+    summary = json.loads(analyzer.stdout)
+    check(summary.get("wedged_phase") in ("step", "dispatch"),
+          f"analyzer blamed phase {summary.get('wedged_phase')!r}, "
+          "expected the wedged step/dispatch")
+    check("wedged" in summary.get("probable_cause", ""),
+          f"probable cause unhelpful: {summary.get('probable_cause')}")
+    return {"wedged_phase": summary.get("wedged_phase"),
+            "bundle_files": len(os.listdir(bundle))}
+
+
 # -- orchestration ----------------------------------------------------------
 
 

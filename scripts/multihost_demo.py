@@ -50,6 +50,39 @@ from sat_tpu import runtime
 state = runtime.train(config)
 print("[p%d] trained to step %d" % (pid, int(jax.device_get(state.step))), flush=True)
 
+if config.fleet_telemetry:
+    # every process's FleetPlane.finish() (train teardown) wrote its
+    # terminal sidecar; barrier so ALL of them are on disk, then process
+    # 0 runs the authoritative file-based merge the demo asserts on.
+    # The barrier is file-based like the fleet plane itself: XLA's CPU
+    # backend cannot run the multiprocess collective sync_processes uses.
+    import time as _time
+    open(os.path.join(config.fleet_dir, "done_p%d" % pid), "w").close()
+    if pid == 0:
+        deadline = _time.time() + 120
+        while _time.time() < deadline:
+            done = [
+                os.path.exists(os.path.join(config.fleet_dir, "done_p%d" % p))
+                for p in range(nprocs)
+            ]
+            if all(done):
+                break
+            _time.sleep(0.2)
+        else:
+            raise SystemExit("fleet barrier timed out: %s" % done)
+        from sat_tpu.telemetry import fleet as fleet_mod
+        doc = fleet_mod.aggregate_directory(
+            config.fleet_dir, config.straggler_factor
+        )
+        s = (doc or {}).get("straggler", {})
+        print(
+            "[p0] fleet final: hosts=%s straggler=%s p%s skew=%s" % (
+                (doc or {}).get("hosts_reporting"),
+                s.get("verdict"), s.get("process_index"), s.get("skew"),
+            ),
+            flush=True,
+        )
+
 if tuple(config.mesh_shape)[1] > 1 and config.context_parallel == 1:
     # vocab-TP mode: the banner must not be earnable with silently
     # replicated params (the placement rule no-ops when vocabulary_size
@@ -127,6 +160,18 @@ def main() -> int:
         "where both mesh_data_shard axes are nontrivial)",
     )
     ap.add_argument(
+        "--fleet", action="store_true",
+        help="fleet telemetry mode: enable the cross-host fleet plane "
+        "with a shared fleet_dir, inject SAT_FI_SLOW_STEP_MS into worker "
+        "0 only, and assert the merged fleet.json reports every host and "
+        "names worker 0 as the straggler",
+    )
+    ap.add_argument(
+        "--slow-ms", type=int, default=75,
+        help="host-side stall injected per step into worker 0 under "
+        "--fleet",
+    )
+    ap.add_argument(
         "--check-loss-parity", action="store_true",
         help="also train a single-process (1,1) control on the same "
         "config/seed and assert the multi-process loss trajectory matches "
@@ -171,6 +216,21 @@ def main() -> int:
         # interleaved slice of the panels (runtime._local_render_rows)
         save_attention_maps=True,
     )
+    if args.fleet:
+        # Straggler visibility needs the hosts DESYNCHRONIZED between log
+        # boundaries: with log_every=1 the boundary's device_get makes
+        # every host wait out the slow one's all-reduce each step and the
+        # host-side step times equalize (lockstep).  A sparse boundary
+        # lets the fast workers' async dispatch run ahead, so only ~2 of
+        # their 40 step spans absorb the collective wait — below the p95
+        # cut — while worker 0 carries the injected stall in EVERY span.
+        config = config.replace(
+            telemetry=True,
+            fleet_telemetry=True,
+            fleet_dir=os.path.join(args.root, "fleet"),
+            straggler_factor=1.5,
+            num_epochs=40, max_steps=40, log_every=20,
+        )
     config.save(os.path.join(args.root, "config.json"))
     # a reused --root must not inflate the final panel-coverage check
     import glob as _glob
@@ -200,15 +260,24 @@ def main() -> int:
         import shutil
 
         for name in [f"summary_p{p}" for p in range(args.procs)] + [
-            "summary_control"
+            "summary_control", "fleet",
         ]:
             shutil.rmtree(os.path.join(args.root, name), ignore_errors=True)
+
+        def worker_env(p):
+            # the straggler injection goes to worker 0 ONLY — a shared
+            # env dict would slow the whole fleet and hide the skew
+            e = dict(env)
+            if args.fleet and p == 0:
+                e["SAT_FI_SLOW_STEP_MS"] = str(args.slow_ms)
+            return e
+
         procs = [
             subprocess.Popen(
                 [sys.executable, "-u", "-c", WORKER,
                  REPO, str(p), str(args.procs), str(port), args.root],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-                env=env,
+                env=worker_env(p),
             )
             for p in range(args.procs)
         ]
@@ -340,6 +409,29 @@ def main() -> int:
         print(f"loss parity vs single-process control: first step rel "
               f"{first_rel:.2e}, trajectory max rel {max_rel:.2e} "
               f"over {len(got)} steps")
+
+    if args.fleet:
+        fleet_path = os.path.join(args.root, "fleet", "fleet.json")
+        try:
+            fleet_doc = json.load(open(fleet_path))
+        except (OSError, ValueError) as e:
+            print(f"FAIL: fleet.json missing/unreadable ({e})")
+            return 1
+        if fleet_doc.get("hosts_reporting") != args.procs:
+            print(f"FAIL: fleet.json reports "
+                  f"{fleet_doc.get('hosts_reporting')} hosts, expected "
+                  f"{args.procs}")
+            return 1
+        verdict = fleet_doc.get("straggler", {})
+        if not verdict.get("verdict") or verdict.get("process_index") != 0:
+            print(f"FAIL: expected worker 0 named as straggler, got "
+                  f"{verdict}")
+            return 1
+        print(f"fleet verdict: p{verdict['process_index']} "
+              f"({verdict.get('host')}) is the straggler at "
+              f"{verdict.get('skew')}x the fleet median "
+              f"(factor {verdict.get('factor')}); "
+              f"{fleet_doc['hosts_reporting']} hosts merged")
 
     mode = (
         "context-parallel" if args.cp

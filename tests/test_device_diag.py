@@ -312,9 +312,12 @@ def _sync_lint_targets():
         )
     # the observability modules added by ISSUE 9 run on the serve request
     # path (tracectx, promtext) or inside loop-adjacent threads (slo,
-    # profwin), so they carry the same contract; the rest of telemetry/
-    # is exempt (exporters' attention dump is an offline boundary)
-    for mod in ("tracectx.py", "promtext.py", "slo.py", "profwin.py"):
+    # profwin), so they carry the same contract — joined by ISSUE 10's
+    # fleet plane and black box, which tick at the train-loop log
+    # boundary; the rest of telemetry/ is exempt (exporters' attention
+    # dump is an offline boundary)
+    for mod in ("tracectx.py", "promtext.py", "slo.py", "profwin.py",
+                "fleet.py", "blackbox.py"):
         targets.append(os.path.join(REPO, "sat_tpu", "telemetry", mod))
     return targets
 
@@ -351,7 +354,7 @@ def test_telemetry_core_is_jax_free():
         "assert 'jax' not in sys.modules\n"
         "from sat_tpu import telemetry\n"
         "from sat_tpu.telemetry import exporters, heartbeat, spans\n"
-        "from sat_tpu.telemetry import profwin, promtext, slo, tracectx\n"
+        "from sat_tpu.telemetry import blackbox, fleet, profwin, promtext, slo, tracectx\n"
         "stamp = telemetry.bench_stamp()\n"
         "assert 'jax' not in sys.modules, 'telemetry core pulled in jax'\n"
         "assert 'platform' not in stamp['device']\n"

@@ -1,0 +1,859 @@
+"""Fleet-telemetry-plane + black-box flight-recorder tests (ISSUE 10).
+
+Pins the contracts of ``telemetry/fleet.py`` and ``telemetry/blackbox.py``
+without ``jax.distributed`` — the file-based aggregation path is the one
+multi-host correctness rests on, so everything here drives it with
+simulated N-process sidecar fixtures:
+
+* sidecar write/read roundtrip; torn/partial/garbage sidecars skipped and
+  counted, never raised on;
+* straggler verdict edge cases: named straggler, strictly-greater-than
+  threshold (equality is "keeping up"), single host, zero median;
+* the gather path: a collective matrix merged with sidecar identity
+  metadata, and graceful fallback when the gather raises;
+* the black-box ring: flattened record format, bounded rotation,
+  torn-line tolerance, resume-on-newest-segment across restarts;
+* the ONE finalizer chain: registration order, failure containment,
+  same-name replacement, reentrancy guard;
+* postmortem bundles: completeness against pre-made artifacts, the
+  flush-before-read ordering, no-op when nothing is installed;
+* ``scripts/analyze_postmortem.py`` heuristics and
+  ``scripts/merge_traces.py`` timestamp alignment (imported directly);
+* heartbeat / bench-stamp multi-host identity and ``fleet/*`` nesting,
+  and the ``gauge_ceiling`` SLO kind the skew objective uses.
+
+The e2e half runs a real ``runtime.train`` with ``--fleet_telemetry
+--blackbox`` and a fault-injected SIGTERM, asserting the shutdown
+ordering leaves a complete bundle (the ISSUE's satellite-3 regression).
+"""
+
+import glob
+import importlib.util
+import json
+import os
+import shutil
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from sat_tpu import runtime, telemetry
+from sat_tpu.telemetry import SCHEMA_VERSION, blackbox, fleet, heartbeat, slo
+from sat_tpu.telemetry.spans import NULL_TELEMETRY, Telemetry
+
+from tests.test_runtime import SMALL_MODEL
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    path = os.path.join(REPO, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_sidecar(fleet_dir, p, step_p95_ms, host=None, **extra):
+    row = {
+        "schema_version": SCHEMA_VERSION,
+        "run_id": "fixture",
+        "process_index": p,
+        "host": host or f"host{p}",
+        "pid": 1000 + p,
+        "time_unix": round(time.time(), 3),
+        "step": 42,
+        "step_p50_ms": step_p95_ms * 0.9,
+        "step_p95_ms": step_p95_ms,
+        "data_wait_ms": 2.0,
+        "dispatch_ms": 1.0,
+        "rss_mb": 512.0,
+        "quarantined": 0.0,
+        **extra,
+    }
+    with open(fleet.sidecar_path(fleet_dir, p), "w") as f:
+        json.dump(row, f)
+    return row
+
+
+def _stepped_tel(step_ms=10.0, steps=64):
+    tel = Telemetry(capacity=256)
+    for _ in range(steps):
+        now = time.perf_counter_ns()
+        tel.record("train/step", now, int(step_ms * 1e6))
+        tel.record("train/data_wait", now, 2_000_000)
+        tel.record("train/dispatch", now, 1_000_000)
+    return tel
+
+
+@pytest.fixture
+def bb_reset():
+    """Isolate the process-wide finalizer chain + installed recorder."""
+    blackbox._reset_for_tests()
+    yield
+    blackbox._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# sidecars: write/read roundtrip + torn tolerance
+# ---------------------------------------------------------------------------
+
+
+class TestSidecars:
+    def test_write_and_read_roundtrip(self, tmp_path):
+        tel = _stepped_tel()
+        plane = fleet.FleetPlane(str(tmp_path), 0, 2, tel)
+        row = plane.write_sidecar(step=7)
+        assert row is not None
+        rows = fleet.read_sidecars(str(tmp_path))
+        assert len(rows) == 1
+        got = rows[0]
+        assert got["process_index"] == 0 and got["process_count"] == 2
+        assert got["step"] == 7 and got["pid"] == os.getpid()
+        assert got["schema_version"] == SCHEMA_VERSION
+        for key in fleet.FLEET_SCALARS:
+            assert key in got
+        assert got["step_p95_ms"] == pytest.approx(10.0, rel=0.05)
+
+    def test_torn_sidecars_skipped_and_counted(self, tmp_path):
+        _write_sidecar(str(tmp_path), 0, 10.0)
+        _write_sidecar(str(tmp_path), 2, 12.0)
+        with open(fleet.sidecar_path(str(tmp_path), 1), "w") as f:
+            f.write('{"process_index": 1, "step_p95_ms":')  # torn mid-write
+        with open(fleet.sidecar_path(str(tmp_path), 3), "w") as f:
+            f.write("[1, 2, 3]")  # parseable but not an object
+        tel = Telemetry()
+        rows = fleet.read_sidecars(str(tmp_path), tel=tel)
+        assert [r["process_index"] for r in rows] == [0, 2]
+        assert tel.counters()["fleet/torn_sidecars"] == 2
+
+    def test_filename_index_backfills_missing_payload_index(self, tmp_path):
+        with open(fleet.sidecar_path(str(tmp_path), 3), "w") as f:
+            json.dump({"step_p95_ms": 5.0}, f)
+        rows = fleet.read_sidecars(str(tmp_path))
+        assert rows[0]["process_index"] == 3
+
+    def test_empty_dir_yields_no_rows(self, tmp_path):
+        assert fleet.read_sidecars(str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# aggregation + straggler verdict edge cases (pure, no IO)
+# ---------------------------------------------------------------------------
+
+
+class TestAggregateRows:
+    def test_summary_medians_max_and_per_host_skew(self):
+        rows = [
+            _row_dict(0, 10.0),
+            _row_dict(1, 20.0),
+            _row_dict(2, 40.0),
+        ]
+        doc = fleet.aggregate_rows(rows, straggler_factor=10.0)
+        assert doc["hosts_reporting"] == 3 and doc["process_count"] == 3
+        assert doc["fleet"]["step_p95_ms_median"] == 20.0
+        assert doc["fleet"]["step_p95_ms_max"] == 40.0
+        assert doc["fleet"]["step_p95_skew"] == 2.0
+        assert [h["skew"] for h in doc["hosts"]] == [0.5, 1.0, 2.0]
+        assert doc["straggler"] == {"verdict": False}
+
+    def test_straggler_named_with_reason(self):
+        rows = [_row_dict(0, 10.0), _row_dict(1, 10.0), _row_dict(2, 100.0)]
+        doc = fleet.aggregate_rows(rows, straggler_factor=2.0)
+        verdict = doc["straggler"]
+        assert verdict["verdict"] is True
+        assert verdict["process_index"] == 2 and verdict["host"] == "host2"
+        assert verdict["step_p95_ms"] == 100.0
+        assert verdict["fleet_median_ms"] == 10.0
+        assert verdict["skew"] == 10.0 and verdict["factor"] == 2.0
+        assert "host2" in verdict["reason"] and "p2" in verdict["reason"]
+
+    def test_exactly_at_threshold_is_not_a_straggler(self):
+        # median of [10, 30] = 20; worst 30 == 20 * 1.5 — equality is
+        # "keeping up", the rule is strictly greater
+        rows = [_row_dict(0, 30.0), _row_dict(1, 10.0)]
+        doc = fleet.aggregate_rows(rows, straggler_factor=1.5)
+        assert doc["straggler"] == {"verdict": False}
+        doc = fleet.aggregate_rows(rows, straggler_factor=1.49)
+        assert doc["straggler"]["verdict"] is True
+        assert doc["straggler"]["process_index"] == 0
+
+    def test_single_host_never_a_straggler(self):
+        doc = fleet.aggregate_rows([_row_dict(0, 1000.0)], straggler_factor=1.1)
+        assert doc["straggler"] == {"verdict": False}
+        assert doc["fleet"]["step_p95_skew"] == 1.0
+
+    def test_zero_median_no_verdict_no_division(self):
+        rows = [_row_dict(0, 0.0), _row_dict(1, 0.0)]
+        doc = fleet.aggregate_rows(rows, straggler_factor=1.5)
+        assert doc["straggler"] == {"verdict": False}
+        assert doc["fleet"]["step_p95_skew"] == 0.0
+        assert all(h["skew"] == 0.0 for h in doc["hosts"])
+
+    def test_garbage_scalars_coerce_to_zero(self):
+        rows = [_row_dict(0, 10.0), _row_dict(1, 10.0)]
+        rows[1]["data_wait_ms"] = "bogus"
+        rows[1]["rss_mb"] = None
+        doc = fleet.aggregate_rows(rows, straggler_factor=2.0)
+        h1 = doc["hosts"][1]
+        assert h1["data_wait_ms"] == 0.0 and h1["rss_mb"] == 0.0
+
+    def test_process_count_override_tracks_absent_hosts(self):
+        doc = fleet.aggregate_rows(
+            [_row_dict(0, 10.0)], straggler_factor=2.0, process_count=4
+        )
+        assert doc["process_count"] == 4 and doc["hosts_reporting"] == 1
+
+    def test_empty_rows(self):
+        doc = fleet.aggregate_rows([], straggler_factor=2.0)
+        assert doc["hosts_reporting"] == 0 and doc["fleet"] == {}
+
+
+def _row_dict(p, p95):
+    return {
+        "process_index": p,
+        "host": f"host{p}",
+        "step_p50_ms": p95 * 0.9,
+        "step_p95_ms": p95,
+        "data_wait_ms": 2.0,
+        "dispatch_ms": 1.0,
+        "rss_mb": 512.0,
+        "quarantined": 0.0,
+    }
+
+
+class TestAggregateDirectory:
+    def test_merges_sidecars_and_writes_fleet_json(self, tmp_path):
+        for p, p95 in enumerate((10.0, 12.0, 95.0)):
+            _write_sidecar(str(tmp_path), p, p95)
+        doc = fleet.aggregate_directory(str(tmp_path), straggler_factor=1.5)
+        assert doc is not None and doc["hosts_reporting"] == 3
+        assert doc["straggler"]["process_index"] == 2
+        on_disk = json.load(open(tmp_path / "fleet.json"))
+        assert on_disk["straggler"] == doc["straggler"]
+
+    def test_empty_dir_returns_none(self, tmp_path):
+        assert (
+            fleet.aggregate_directory(str(tmp_path), straggler_factor=1.5)
+            is None
+        )
+        assert not (tmp_path / "fleet.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# FleetPlane.tick: roles, gather path, publication, degradation
+# ---------------------------------------------------------------------------
+
+
+class TestFleetPlane:
+    def test_nonzero_process_writes_sidecar_but_never_aggregates(self, tmp_path):
+        plane = fleet.FleetPlane(str(tmp_path), 1, 2, _stepped_tel())
+        assert plane.tick(5) is None
+        assert os.path.isfile(fleet.sidecar_path(str(tmp_path), 1))
+        assert not (tmp_path / "fleet.json").exists()
+
+    def test_p0_aggregates_publishes_gauges_and_history(self, tmp_path):
+        tel = _stepped_tel(step_ms=10.0)
+        _write_sidecar(str(tmp_path), 1, 100.0, host="slowhost")
+        plane = fleet.FleetPlane(
+            str(tmp_path), 0, 2, tel, straggler_factor=1.5
+        )
+        doc = plane.tick(5)
+        assert doc["hosts_reporting"] == 2
+        assert doc["straggler"]["verdict"] is True
+        assert doc["straggler"]["process_index"] == 1
+        assert doc["straggler"]["host"] == "slowhost"
+        gauges = tel.gauges()
+        assert gauges["fleet/hosts_reporting"] == 2
+        assert gauges["fleet/straggler_index"] == 1
+        assert gauges["fleet/step_p95_skew"] > 1.5
+        assert gauges["fleet/step_p95_ms_max"] == 100.0
+        assert (tmp_path / "fleet.json").is_file()
+        history = [
+            json.loads(line)
+            for line in open(tmp_path / "fleet_history.jsonl")
+        ]
+        assert history and history[-1]["straggler"]["process_index"] == 1
+
+    def test_no_straggler_gauges_minus_one(self, tmp_path):
+        tel = _stepped_tel(step_ms=10.0)
+        _write_sidecar(str(tmp_path), 1, 10.0)
+        plane = fleet.FleetPlane(str(tmp_path), 0, 2, tel, straggler_factor=2.0)
+        doc = plane.tick(1)
+        assert doc["straggler"] == {"verdict": False}
+        assert tel.gauges()["fleet/straggler_index"] == -1
+
+    def test_gather_path_merges_matrix_with_sidecar_identity(self, tmp_path):
+        tel = _stepped_tel(step_ms=10.0)
+        # the peer's sidecar carries identity but STALE scalars — the
+        # gathered matrix must win for FLEET_SCALARS
+        _write_sidecar(str(tmp_path), 1, 1.0, host="peerhost")
+        plane = fleet.FleetPlane(str(tmp_path), 0, 2, tel, straggler_factor=1.5)
+        calls = []
+
+        def gather_fn(vec):
+            calls.append(vec)
+            peer = np.array([90.0, 100.0, 5.0, 2.0, 1024.0, 3.0])
+            return np.stack([np.asarray(vec, np.float64), peer])
+
+        doc = plane.tick(9, gather_fn=gather_fn)
+        assert len(calls) == 1 and calls[0].shape == (len(fleet.FLEET_SCALARS),)
+        assert doc["hosts_reporting"] == 2
+        h1 = doc["hosts"][1]
+        assert h1["host"] == "peerhost"  # identity from the sidecar
+        assert h1["step_p95_ms"] == 100.0  # scalars from the gather
+        assert h1["quarantined"] == 3.0
+        assert doc["straggler"]["process_index"] == 1
+
+    def test_gather_failure_falls_back_to_sidecars(self, tmp_path, capsys):
+        tel = _stepped_tel()
+        _write_sidecar(str(tmp_path), 1, 100.0)
+        plane = fleet.FleetPlane(str(tmp_path), 0, 2, tel, straggler_factor=1.5)
+
+        def bad_gather(vec):
+            raise RuntimeError("collective timed out")
+
+        doc = plane.tick(3, gather_fn=bad_gather)
+        assert doc is not None and doc["hosts_reporting"] == 2
+        assert "falling back to sidecars" in capsys.readouterr().err
+
+    def test_finish_is_file_based_and_never_raises(self, tmp_path):
+        tel = _stepped_tel()
+        plane = fleet.FleetPlane(str(tmp_path), 0, 1, tel)
+        plane.tick(4)
+        doc = plane.finish()
+        assert doc is not None and doc["hosts"][0]["step"] == 4
+        # a destroyed fleet dir degrades to a warning, not an exception
+        shutil.rmtree(tmp_path)
+        assert plane.finish() is None
+
+
+# ---------------------------------------------------------------------------
+# the black-box ring
+# ---------------------------------------------------------------------------
+
+
+class TestBlackBoxRing:
+    def test_append_flattens_fields_into_records(self, tmp_path):
+        tel = Telemetry()
+        tel.count("data/batches", 5)
+        tel.gauge("train/step", 9)
+        bb = blackbox.BlackBox(str(tmp_path), tel)
+        bb.event("sentinel_trip", step=3, reason="nan")
+        bb.journal(9)
+        records, torn = bb.read_all()
+        assert torn == 0 and len(records) == 2
+        ev, snap = records
+        assert ev["kind"] == "event" and ev["event"] == "sentinel_trip"
+        assert ev["step"] == 3 and ev["reason"] == "nan"
+        assert "t" in ev and "mono_ns" in ev
+        assert snap["kind"] == "snapshot" and snap["step"] == 9
+        assert snap["counters"]["data/batches"] == 5
+        assert snap["gauges"]["train/step"] == 9
+
+    def test_rotation_bounds_disk_use(self, tmp_path):
+        bb = blackbox.BlackBox(
+            str(tmp_path), Telemetry(), segment_bytes=4096, segments=3
+        )
+        payload = "x" * 100
+        for i in range(400):  # ~150 bytes/record * 400 >> 3 * 4096
+            bb.append("noise", {"i": i, "pad": payload})
+        segs = glob.glob(str(tmp_path / "seg_*.jsonl"))
+        assert len(segs) <= 3
+        total = sum(os.path.getsize(s) for s in segs)
+        # cap + one record of slop per segment (rotation happens at >=)
+        assert total <= 3 * (4096 + 200)
+        # the newest records survived; the oldest rotated away
+        records, _ = bb.read_all()
+        ids = [r["i"] for r in records if "i" in r]
+        assert max(ids) == 399 and min(ids) > 0
+
+    def test_torn_lines_skipped_not_fatal(self, tmp_path):
+        bb = blackbox.BlackBox(str(tmp_path), Telemetry())
+        bb.event("ok", n=1)
+        with open(tmp_path / "seg_000.jsonl", "a") as f:
+            f.write('{"t": 99, "kind": "event", "ev')  # killed mid-append
+        records, torn = bb.read_all()
+        assert torn == 1
+        assert [r["event"] for r in records if r["kind"] == "event"] == ["ok"]
+
+    def test_restart_resumes_on_newest_segment(self, tmp_path):
+        bb = blackbox.BlackBox(
+            str(tmp_path), Telemetry(), segment_bytes=4096, segments=4
+        )
+        for i in range(120):
+            bb.append("noise", {"i": i, "pad": "x" * 100})
+        assert bb._idx > 0  # the ring rotated
+        bb2 = blackbox.BlackBox(
+            str(tmp_path), Telemetry(), segment_bytes=4096, segments=4
+        )
+        assert bb2._idx == bb._idx
+        bb2.event("after_restart")
+        records, _ = bb2.read_all()
+        assert any(r.get("event") == "after_restart" for r in records)
+
+    def test_unserializable_record_degrades(self, tmp_path, capsys):
+        bb = blackbox.BlackBox(str(tmp_path), Telemetry())
+        bb.append("bad", {"obj": object()})  # must not raise
+        records, torn = bb.read_all()
+        assert records == [] and torn == 0
+        assert "black box degraded" in capsys.readouterr().err
+
+    def test_span_tail_wall_clock_anchoring(self, tmp_path):
+        tel = Telemetry()
+        with tel.span("train/step"):
+            time.sleep(0.01)
+        bb = blackbox.BlackBox(str(tmp_path), tel)
+        tail = bb.span_tail(30.0)
+        assert len(tail) == 1
+        span = tail[0]
+        assert span["name"] == "train/step"
+        assert span["dur_ms"] >= 10.0
+        assert abs(span["t_unix"] - time.time()) < 5.0
+
+    def test_span_tail_null_telemetry_is_empty(self, tmp_path):
+        bb = blackbox.BlackBox(str(tmp_path), NULL_TELEMETRY)
+        assert bb.span_tail() == []
+
+
+# ---------------------------------------------------------------------------
+# the finalizer chain (shutdown-ordering contract)
+# ---------------------------------------------------------------------------
+
+
+class TestFinalizerChain:
+    def test_runs_in_registration_order_with_containment(self, bb_reset, capsys):
+        calls = []
+        blackbox.register_finalizer("a", lambda: calls.append("a"))
+        blackbox.register_finalizer("boom", lambda: 1 / 0)
+        blackbox.register_finalizer("b", lambda: calls.append("b"))
+        blackbox.run_finalizers()  # must not raise
+        assert calls == ["a", "b"]
+        assert "finalizer 'boom' failed" in capsys.readouterr().err
+
+    def test_same_name_replaces_not_stacks(self, bb_reset):
+        calls = []
+        blackbox.register_finalizer("ring", lambda: calls.append("stale"))
+        blackbox.register_finalizer("ring", lambda: calls.append("fresh"))
+        blackbox.run_finalizers()
+        assert calls == ["fresh"]
+
+    def test_reentrancy_guarded(self, bb_reset):
+        calls = []
+
+        def recursing():
+            calls.append("outer")
+            blackbox.run_finalizers()  # a finalizer crashing into dump()
+
+        blackbox.register_finalizer("recurse", recursing)
+        blackbox.register_finalizer("tail", lambda: calls.append("tail"))
+        blackbox.run_finalizers()
+        assert calls == ["outer", "tail"]  # inner call was a no-op
+
+    def test_safe_to_run_twice(self, bb_reset):
+        calls = []
+        blackbox.register_finalizer("idem", lambda: calls.append(1))
+        blackbox.run_finalizers()
+        blackbox.run_finalizers()
+        assert calls == [1, 1]
+
+
+# ---------------------------------------------------------------------------
+# install + postmortem bundles
+# ---------------------------------------------------------------------------
+
+
+def _seed_artifacts(tdir, fdir):
+    os.makedirs(tdir, exist_ok=True)
+    os.makedirs(fdir, exist_ok=True)
+    json.dump({"seq": 7}, open(os.path.join(tdir, "heartbeat.json"), "w"))
+    open(os.path.join(tdir, "watchdog_stacks.txt"), "w").write("Thread-1\n")
+    json.dump({"compiles": 2}, open(os.path.join(tdir, "compile_report.json"), "w"))
+    json.dump({"step_ms": 30}, open(os.path.join(tdir, "breakdown.json"), "w"))
+    with open(os.path.join(tdir, "slo.jsonl"), "w") as f:
+        for i in range(250):  # > the 200-line tail cap
+            f.write(json.dumps({"i": i}) + "\n")
+    open(os.path.join(tdir, "telemetry.jsonl"), "w").write('{"k": 1}\n')
+    json.dump(
+        {"hosts_reporting": 2, "straggler": {"verdict": False}},
+        open(os.path.join(fdir, "fleet.json"), "w"),
+    )
+    _write_sidecar(fdir, 0, 10.0)
+    _write_sidecar(fdir, 1, 11.0)
+    open(os.path.join(fdir, "fleet_history.jsonl"), "w").write('{"h": 1}\n')
+
+
+class TestPostmortemBundles:
+    def test_dump_is_noop_when_not_installed(self, bb_reset):
+        assert blackbox.installed() is None
+        assert blackbox.dump("anything", exit_code=86) is None
+
+    def test_install_threads_ring_onto_chain(self, bb_reset, tmp_path):
+        bb = blackbox.BlackBox(str(tmp_path / "ring"), Telemetry())
+        blackbox.install(bb, telemetry_dir=str(tmp_path))
+        assert blackbox.installed() is bb
+        assert any(name == "blackbox-ring" for name, _ in blackbox._FINALIZERS)
+        blackbox.uninstall()
+        assert blackbox.installed() is None
+
+    def test_bundle_completeness(self, bb_reset, tmp_path):
+        tdir = str(tmp_path / "telemetry")
+        fdir = str(tmp_path / "fleet")
+        ledger = str(tmp_path / "quarantine.jsonl")
+        _seed_artifacts(tdir, fdir)
+        open(ledger, "w").write('{"shard": "s3"}\n')
+
+        tel = Telemetry()
+        with tel.span("train/step"):
+            time.sleep(0.001)
+        tel.gauge("train/step", 5)
+        bb = blackbox.BlackBox(os.path.join(tdir, "blackbox"), tel)
+        bb.journal(5)
+        bb.event("anomaly_rollback", step=5, reason="nan")
+        # flush-before-read: a finalizer lands one LAST record; it must be
+        # inside the copied ring, proving the chain ran before the copy
+        blackbox.install(
+            bb,
+            telemetry_dir=tdir,
+            fleet_dir=fdir,
+            config_snapshot={"model_dims": 16},
+            quarantine_ledger=ledger,
+        )
+        blackbox.register_finalizer(
+            "marker", lambda: bb.event("flushed_by_chain")
+        )
+
+        bundle = blackbox.dump(
+            "anomaly_rollback", exit_code=None, step=5, reason_detail="nan"
+        )
+        assert bundle is not None and os.path.isdir(bundle)
+        assert os.path.dirname(bundle) == tdir
+        assert os.path.basename(bundle) == f"postmortem_{telemetry.run_id()}"
+
+        manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+        assert manifest["schema_version"] == SCHEMA_VERSION
+        assert manifest["reason"] == "anomaly_rollback"
+        assert manifest["exit_code"] is None
+        assert manifest["pid"] == os.getpid()
+        assert manifest["step"] == 5 and manifest["reason"] == "anomaly_rollback"
+        assert manifest["last_phase"] == "train/step"
+
+        for name in (
+            "spans_tail.json",
+            "state.json",
+            "heartbeat.json",
+            "watchdog_stacks.txt",
+            "compile_report.json",
+            "breakdown.json",
+            "fleet.json",
+            "heartbeat_p0.json",
+            "heartbeat_p1.json",
+            "slo.jsonl",
+            "telemetry.jsonl",
+            "fleet_history.jsonl",
+            "quarantine.jsonl",
+            "config.json",
+        ):
+            assert os.path.isfile(os.path.join(bundle, name)), name
+
+        assert len(open(os.path.join(bundle, "slo.jsonl")).readlines()) == 200
+        assert json.load(open(os.path.join(bundle, "config.json"))) == {
+            "model_dims": 16
+        }
+        state = json.load(open(os.path.join(bundle, "state.json")))
+        assert state["gauges"]["train/step"] == 5
+        spans = json.load(open(os.path.join(bundle, "spans_tail.json")))
+        assert spans and spans[-1]["name"] == "train/step"
+
+        copied = glob.glob(os.path.join(bundle, "blackbox", "seg_*.jsonl"))
+        assert copied
+        ring = [
+            json.loads(line) for seg in copied for line in open(seg)
+        ]
+        events = [r.get("event") for r in ring if r["kind"] == "event"]
+        assert "anomaly_rollback" in events
+        assert "flushed_by_chain" in events  # the chain ran BEFORE the copy
+
+    def test_dump_with_missing_artifacts_still_bundles(self, bb_reset, tmp_path):
+        tdir = str(tmp_path / "bare")
+        bb = blackbox.BlackBox(os.path.join(tdir, "blackbox"), Telemetry())
+        blackbox.install(bb, telemetry_dir=tdir)
+        bundle = blackbox.dump("uncaught_exception", exit_code=1, error="boom")
+        assert bundle is not None
+        manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+        assert manifest["error"] == "boom" and manifest["last_phase"] is None
+
+
+# ---------------------------------------------------------------------------
+# scripts/analyze_postmortem.py heuristics
+# ---------------------------------------------------------------------------
+
+
+class TestAnalyzePostmortem:
+    @pytest.fixture(scope="class")
+    def mod(self):
+        return _load_script("analyze_postmortem")
+
+    def _bundle(self, tmp_path, manifest, **files):
+        bundle = tmp_path / "postmortem_test"
+        bundle.mkdir()
+        json.dump(manifest, open(bundle / "manifest.json", "w"))
+        for name, doc in files.items():
+            with open(bundle / name.replace("__", "."), "w") as f:
+                if name.endswith("jsonl"):
+                    for row in doc:
+                        f.write(json.dumps(row) + "\n")
+                else:
+                    json.dump(doc, f)
+        return str(bundle)
+
+    def test_watchdog_wedge_names_the_phase(self, mod, tmp_path):
+        bundle = self._bundle(
+            tmp_path,
+            {"reason": "watchdog_wedge", "exit_code": 86, "phase": "step",
+             "overdue_s": 7.5},
+        )
+        out = mod.summarize(bundle)
+        assert out["wedged_phase"] == "step"
+        assert "wedged" in out["probable_cause"]
+        assert "exit 86" in out["probable_cause"]
+
+    def test_corruption_cites_quarantine_evidence(self, mod, tmp_path):
+        bundle = self._bundle(
+            tmp_path,
+            {"reason": "systemic_corruption", "exit_code": 87},
+            quarantine__jsonl=[{"shard": "s1"}, {"shard": "s2"}],
+        )
+        out = mod.summarize(bundle)
+        assert "corruption" in out["probable_cause"]
+        assert "restarting will NOT help" in out["probable_cause"]
+        assert any("quarantine" in ev for ev in out["evidence"])
+
+    def test_straggler_verdict_surfaces_as_evidence(self, mod, tmp_path):
+        bundle = self._bundle(
+            tmp_path,
+            {"reason": "watchdog_wedge", "exit_code": 86, "phase": "step"},
+            fleet__json={
+                "straggler": {
+                    "verdict": True, "process_index": 3,
+                    "host": "slowhost", "skew": 4.2,
+                }
+            },
+        )
+        out = mod.summarize(bundle)
+        assert out["straggler"]["process_index"] == 3
+        assert any("slowhost" in ev for ev in out["evidence"])
+
+    def test_sigterm_reports_final_checkpoint(self, mod, tmp_path):
+        bundle = self._bundle(
+            tmp_path,
+            {"reason": "sigterm_during_checkpoint", "exit_code": 0,
+             "signal": "SIGTERM", "final_checkpoint": "/ckpt/6.npz"},
+        )
+        out = mod.summarize(bundle)
+        assert "SIGTERM" in out["probable_cause"]
+        assert "/ckpt/6.npz" in out["probable_cause"]
+
+    def test_find_bundle_picks_newest(self, mod, tmp_path):
+        old = tmp_path / "postmortem_old"
+        new = tmp_path / "postmortem_new"
+        for d, age in ((old, 100), (new, 0)):
+            d.mkdir()
+            json.dump({}, open(d / "manifest.json", "w"))
+            t = time.time() - age
+            os.utime(d, (t, t))
+        assert mod._find_bundle(str(tmp_path)) == str(new)
+        assert mod._find_bundle(str(old)) == str(old)
+        assert mod._find_bundle(str(tmp_path / "nowhere")) is None
+
+
+# ---------------------------------------------------------------------------
+# scripts/merge_traces.py: one timeline, a lane per host
+# ---------------------------------------------------------------------------
+
+
+class TestMergeTraces:
+    @pytest.fixture(scope="class")
+    def mod(self):
+        return _load_script("merge_traces")
+
+    def test_anchors_align_timestamps(self, mod):
+        doc0 = {
+            "traceEvents": [{"name": "step", "ph": "X", "pid": 0, "ts": 100.0}],
+            "otherData": {"anchor_unix": 1000.0, "process_index": 0},
+        }
+        doc1 = {
+            "traceEvents": [{"name": "step", "ph": "X", "pid": 1, "ts": 100.0}],
+            "otherData": {"anchor_unix": 1002.5, "process_index": 1},
+        }
+        merged = mod.merge([doc0, doc1])
+        by_pid = {
+            e["pid"]: e for e in merged["traceEvents"] if e.get("ph") == "X"
+        }
+        assert by_pid[0]["ts"] == 100.0  # the earliest anchor is the base
+        assert by_pid[1]["ts"] == 100.0 + 2.5e6  # shifted by the skew, in us
+        assert merged["otherData"]["anchor_unix"] == 1000.0
+        assert merged["displayTimeUnit"] == "ms"
+        shifts = {
+            h["process_index"]: h["shift_us"]
+            for h in merged["otherData"]["merged_from"]
+        }
+        assert shifts == {0: 0.0, 1: 2.5e6}
+
+    def test_missing_anchor_merges_unshifted(self, mod, capsys):
+        doc = {"traceEvents": [{"name": "e", "ph": "X", "pid": 4, "ts": 7.0}]}
+        merged = mod.merge([doc])
+        ev = [e for e in merged["traceEvents"] if e.get("ph") == "X"][0]
+        assert ev["ts"] == 7.0
+        assert "no anchor_unix" in capsys.readouterr().err
+
+    def test_process_name_lanes_injected_once(self, mod):
+        named = {
+            "traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": 0,
+                 "args": {"name": "custom"}},
+                {"name": "e", "ph": "X", "pid": 0, "ts": 1.0},
+            ],
+            "otherData": {"anchor_unix": 1.0, "process_index": 0},
+        }
+        anonymous = {
+            "traceEvents": [{"name": "e", "ph": "X", "pid": 1, "ts": 1.0}],
+            "otherData": {"anchor_unix": 1.0, "process_index": 1},
+        }
+        merged = mod.merge([named, anonymous])
+        meta = [
+            e for e in merged["traceEvents"] if e.get("name") == "process_name"
+        ]
+        assert {e["pid"] for e in meta} == {0, 1}
+        assert len([e for e in meta if e["pid"] == 0]) == 1  # not duplicated
+        injected = [e for e in meta if e["pid"] == 1][0]
+        assert injected["args"]["name"] == "sat_tpu host p1"
+
+
+# ---------------------------------------------------------------------------
+# identity stamping + heartbeat nesting + the skew SLO
+# ---------------------------------------------------------------------------
+
+
+class TestFleetSurfaces:
+    def test_bench_stamp_carries_process_identity(self):
+        stamp = telemetry.bench_stamp()
+        assert stamp["process_index"] == 0 and stamp["process_count"] >= 1
+
+    def test_heartbeat_nests_fleet_gauges_and_identity(self, tmp_path):
+        tel = Telemetry()
+        tel.gauge("fleet/hosts_reporting", 2)
+        tel.gauge("fleet/step_p95_skew", 3.2)
+        tel.gauge("fleet/straggler_index", 1)
+        hb = heartbeat.Heartbeat(str(tmp_path / "heartbeat.json"), 1.0, tel)
+        payload = hb.payload()
+        assert payload["process_index"] == 0
+        assert payload["process_count"] >= 1
+        assert payload["fleet"]["hosts_reporting"] == 2
+        assert payload["fleet"]["step_p95_skew"] == 3.2
+        assert payload["fleet"]["straggler_index"] == 1
+
+    def test_gauge_ceiling_kind_burns_on_sustained_skew(self):
+        tel = Telemetry()
+        obj = slo.Objective(
+            name="fleet_step_skew",
+            kind="gauge_ceiling",
+            target=1.5,
+            source="fleet/step_p95_skew",
+        )
+        engine = slo.SLOEngine(tel, [obj])
+        # absent gauge: no data, never burning
+        result = engine.tick()["fleet_step_skew"]
+        assert result["burning"] is False and result["measured_fast"] is None
+        tel.gauge("fleet/step_p95_skew", 3.0)
+        result = engine.tick()["fleet_step_skew"]
+        assert result["burning"] is True
+        assert result["measured_fast"] == 3.0 and result["burn_fast"] == 2.0
+        tel.gauge("fleet/step_p95_skew", 1.2)
+        assert engine.tick()["fleet_step_skew"]["burning"] is False
+
+    def test_fleet_objective_gated_on_config(self, coco_fixture):
+        base = coco_fixture["config"]
+        on = base.replace(fleet_telemetry=True, straggler_factor=1.75)
+        names = {o.name: o for o in slo.objectives_from_config(on, "train")}
+        assert "fleet_step_skew" in names
+        obj = names["fleet_step_skew"]
+        assert obj.kind == "gauge_ceiling" and obj.target == 1.75
+        assert obj.source == "fleet/step_p95_skew"
+        off = base.replace(fleet_telemetry=False)
+        assert "fleet_step_skew" not in {
+            o.name for o in slo.objectives_from_config(off, "train")
+        }
+
+
+# ---------------------------------------------------------------------------
+# e2e: runtime.train with the fleet plane + black box under fault injection
+# (the satellite-3 shutdown-ordering regression)
+# ---------------------------------------------------------------------------
+
+
+def _cfg(coco_fixture, tmp_path, name, **kw):
+    return coco_fixture["config"].replace(
+        **{
+            **SMALL_MODEL,
+            "save_dir": str(tmp_path / name),
+            "summary_dir": str(tmp_path / (name + "_s")),
+            **kw,
+        }
+    )
+
+
+class TestTrainIntegration:
+    def test_sigterm_during_checkpoint_leaves_complete_bundle(
+        self, coco_fixture, tmp_path, monkeypatch, bb_reset
+    ):
+        """A fault-injected SIGTERM at the checkpoint boundary must leave a
+        postmortem bundle whose ring was flushed through the finalizer
+        chain — the exit paths may not tear the ring down first."""
+        cfg = _cfg(
+            coco_fixture,
+            tmp_path,
+            "bbx",
+            telemetry=True,
+            blackbox=True,
+            fleet_telemetry=True,
+        )
+        monkeypatch.setenv("SAT_FI_SIGTERM_AT_STEP", "4")
+        state = runtime.train(cfg)
+        assert int(state.step) == 4
+
+        tdir = os.path.join(cfg.summary_dir, "telemetry")
+        bundles = glob.glob(os.path.join(tdir, "postmortem_*"))
+        assert len(bundles) == 1
+        bundle = bundles[0]
+        manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+        assert manifest["reason"] == "sigterm_during_checkpoint"
+        assert manifest["signal"] == "SIGTERM"
+        assert manifest["step"] == 4
+        assert manifest["final_checkpoint"].endswith("4.npz")
+
+        # the ring journaled the run and recorded the stop event
+        segs = glob.glob(os.path.join(bundle, "blackbox", "seg_*.jsonl"))
+        assert segs
+        ring = [json.loads(line) for seg in segs for line in open(seg)]
+        events = [r.get("event") for r in ring if r["kind"] == "event"]
+        assert "train_start" in events and "sigterm_stop" in events
+        snaps = [r for r in ring if r["kind"] == "snapshot"]
+        assert snaps and snaps[-1]["step"] >= 1
+
+        # the single-host fleet plane rode along: sidecar + merged view,
+        # no straggler (one host), and the bundle copied both
+        fleet_doc = json.load(open(os.path.join(bundle, "fleet.json")))
+        assert fleet_doc["hosts_reporting"] == 1
+        assert fleet_doc["straggler"] == {"verdict": False}
+        assert os.path.isfile(os.path.join(bundle, "heartbeat_p0.json"))
+        assert json.load(
+            open(os.path.join(tdir, "fleet.json"))
+        )["hosts"][0]["process_index"] == 0
+
+        # the analyzer reads the bundle cold
+        mod = _load_script("analyze_postmortem")
+        summary = mod.summarize(bundle)
+        assert "SIGTERM" in summary["probable_cause"]
+        assert summary["run_id"] == manifest["run_id"]
