@@ -200,6 +200,13 @@ def build_config(argv: Optional[List[str]] = None):
              "docs/SERVING.md)",
     )
     p.add_argument(
+        "--serve_mode", choices=("batch", "continuous"), default=None,
+        help="serve phase: 'batch' dispatches whole padded micro-batches "
+             "(the correctness oracle); 'continuous' admits requests into "
+             "a paged slot pool between decode steps and retires finished "
+             "beams early (docs/SERVING.md)",
+    )
+    p.add_argument(
         "--supervise", action="store_true",
         help="crash-only restart loop (docs/RESILIENCE.md): keep this "
              "process jax-free and run the real work in a child; a child "
@@ -296,6 +303,8 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(serve_max_batch=args.max_batch)
     if args.max_wait_ms is not None:
         config = config.replace(serve_max_wait_ms=args.max_wait_ms)
+    if args.serve_mode is not None:
+        config = config.replace(serve_mode=args.serve_mode)
     if args.watchdog is not None:
         config = config.replace(watchdog_interval=args.watchdog)
     overrides = {}

@@ -218,6 +218,18 @@ class Config:
     # 503 "degraded", and triggers an engine re-warm — a wedged device
     # dispatch degrades the service instead of hanging it forever
     serve_wedge_timeout_ms: float = 0.0
+    # dispatch discipline: "batch" gathers whole padded batches through
+    # the monolithic beam_search (the correctness oracle); "continuous"
+    # admits requests into a fixed-capacity paged slot pool between
+    # decode steps and retires finished beams early (docs/SERVING.md)
+    serve_mode: str = "batch"
+    # continuous-mode pool geometry: serve_slot_pages pages of
+    # serve_page_width slots each (page_width caps the admission lane —
+    # encode lanes at each power-of-two width up to it are AOT-warmed
+    # once, and a burst of admissions encodes at the smallest lane that
+    # fits before one init_slots gather seeds the free slots)
+    serve_slot_pages: int = 4
+    serve_page_width: int = 4
 
     # ---- dataset-size caps (reference config.py:60-63) ----
     max_train_ann_num: Optional[int] = 1000
@@ -429,6 +441,15 @@ class Config:
         if self.serve_queue_depth <= 0 or self.serve_port < 0:
             raise ValueError(
                 "Config.serve_queue_depth must be > 0 and serve_port >= 0"
+            )
+        if self.serve_mode not in ("batch", "continuous"):
+            raise ValueError(
+                f"Config.serve_mode={self.serve_mode!r}: must be 'batch' "
+                "or 'continuous'"
+            )
+        if self.serve_slot_pages <= 0 or self.serve_page_width <= 0:
+            raise ValueError(
+                "Config.serve_slot_pages and serve_page_width must be >= 1"
             )
         for name in (
             "watchdog_interval",

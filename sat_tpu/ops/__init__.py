@@ -1,3 +1,25 @@
-from .beam_search import BeamResult, beam_search, beam_search_jit, greedy_decode
+from .beam_search import (
+    BeamResult,
+    SlotCarry,
+    beam_search,
+    beam_search_jit,
+    decode_step,
+    greedy_decode,
+    harvest_slots,
+    init_slot_pool,
+    init_slots,
+    retire_slots,
+)
 
-__all__ = ["BeamResult", "beam_search", "beam_search_jit", "greedy_decode"]
+__all__ = [
+    "BeamResult",
+    "SlotCarry",
+    "beam_search",
+    "beam_search_jit",
+    "decode_step",
+    "greedy_decode",
+    "harvest_slots",
+    "init_slot_pool",
+    "init_slots",
+    "retire_slots",
+]

@@ -24,6 +24,23 @@ reference's per-beam top-(K+1) heap pushes — a strictly-at-least-as-good
 candidate set, computed as one ``lax.top_k`` on device.
 
 Greedy decoding is the beam_size=1 special case of the same program.
+
+Two drivers run the SAME expansion math (``_expand_step``):
+
+* the monolithic ``run_search`` while_loop — one dispatch per batch, the
+  offline/eval path and the serving correctness oracle;
+* the resumable stepped decode (``decode_step`` over a ``SlotCarry``) —
+  the serve engine's continuous-batching path, where each slot of a
+  fixed-capacity pool advances independently, new requests are seeded
+  into free slots between steps (``init_slots``) and finished slots are
+  harvested the step their early-exit condition fires
+  (``harvest_slots``).  Per-slot results are bitwise-identical to the
+  monolithic search because both paths share one step body: a slot
+  freezes the step it seals (all K finished slots filled and
+  min(fin) ≥ max(live)) — from that step on the monolithic search can no
+  longer alter that image's merged result either (a later completion
+  scores ≤ max(live) ≤ min(fin), and ``lax.top_k`` tie-breaks toward the
+  lower index, where the finished entries sit).
 """
 
 from __future__ import annotations
@@ -62,11 +79,184 @@ class BeamResult(NamedTuple):
     # (soft-attention α over the context grid at the step that emitted
     # word t); None unless return_alphas was set
     alphas: Optional[jnp.ndarray] = None
-    # scalar int32 count of decode-loop iterations actually executed —
-    # the deterministic observability probe for the early exit (None
-    # unless return_steps was set, so the default output pytree — and
-    # the shard_map out_specs built from it — is unchanged)
+    # decode-loop iterations actually executed — the deterministic
+    # observability probe for the early exit (None unless return_steps
+    # was set, so the default output pytree — and the shard_map out_specs
+    # built from it — is unchanged).  Scalar int32 from run_search;
+    # per-slot [S] int32 from harvest_slots.
     steps_run: Optional[jnp.ndarray] = None
+
+
+class SearchState(NamedTuple):
+    """The pure search bookkeeping of ``B`` independent images — everything
+    the expansion step reads/writes besides the decoder's LSTM state."""
+
+    live_logp: jnp.ndarray    # [B, K] cumulative log-prob of live beams
+    live_words: jnp.ndarray   # [B, K, T]
+    live_len: jnp.ndarray     # [B, K]
+    last_word: jnp.ndarray    # [B, K] input word of the NEXT step
+    fin_logp: jnp.ndarray     # [B, K] finished top-K (NEG_INF = empty slot)
+    fin_words: jnp.ndarray    # [B, K, T]
+    fin_len: jnp.ndarray      # [B, K]
+    live_alphas: jnp.ndarray  # [B, K, T, An] (An=0 unless return_alphas)
+    fin_alphas: jnp.ndarray   # [B, K, T, An]
+
+
+def _init_search(B: int, K: int, T: int, An: int) -> SearchState:
+    # beam 0 alive at logp 0; others dead so step 0 expands a single beam
+    return SearchState(
+        live_logp=jnp.full((B, K), NEG_INF, jnp.float32).at[:, 0].set(0.0),
+        live_words=jnp.zeros((B, K, T), jnp.int32),
+        live_len=jnp.zeros((B, K), jnp.int32),
+        last_word=jnp.zeros((B, K), jnp.int32),  # <start> = 0 (model.py:253)
+        fin_logp=jnp.full((B, K), NEG_INF, jnp.float32),
+        fin_words=jnp.zeros((B, K, T), jnp.int32),
+        fin_len=jnp.zeros((B, K), jnp.int32),
+        live_alphas=jnp.zeros((B, K, T, An), jnp.float32),
+        fin_alphas=jnp.zeros((B, K, T, An), jnp.float32),
+    )
+
+
+def _expand_step(
+    eos_id: int,
+    K: int,
+    V: int,
+    An: int,
+    valid_size: Optional[int],
+    new_state: DecoderState,
+    logits: jnp.ndarray,
+    alpha: jnp.ndarray,
+    t_vec: jnp.ndarray,
+    s: SearchState,
+):
+    """One beam-expansion step over ``B`` independent rows — the single
+    implementation both the monolithic while_loop and the stepped slot
+    pool run (bitwise parity between the two paths is BY CONSTRUCTION).
+
+    new_state/logits/alpha: the decoder step's outputs over the flattened
+    [B*K] beam batch.  t_vec [B] int32: each row's own time index —
+    per-row because pool slots run staggered; the monolithic driver
+    passes the loop counter broadcast to all rows.  Time-indexed writes
+    use a one-hot select over the T axis (value-identical to an
+    ``.at[:, :, t].set``, which needs a scalar t).
+    """
+    B = s.live_logp.shape[0]
+    T = s.live_words.shape[2]
+    H = new_state.output.shape[-1]
+    batch_idx = jnp.arange(B)[:, None]  # [B,1] for beam gathers
+    t_hot = jnp.arange(T)[None, :] == t_vec[:, None]            # [B,T]
+
+    step_alpha = alpha.reshape(B, K, -1)[:, :, :An]             # [B,K,An]
+    if valid_size is not None and valid_size < V:
+        logits = logits.at[:, valid_size:].set(NEG_INF)
+    step_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    step_logp = step_logp.reshape(B, K, V)
+    logp = step_logp + s.live_logp[..., None]          # [B,K,V] cumulative
+
+    # --- completions: an eos hypothesis only becomes a candidate when
+    # eos is within its beam's top-(K+1) next words — the reference only
+    # ever pushes words from that set (base_model.py:219-230), so junk
+    # completions can't crowd out the partial-beam fallback.
+    kth = jax.lax.top_k(step_logp, min(K + 1, V))[0][..., -1]   # [B,K]
+    eos_allowed = step_logp[:, :, eos_id] >= kth
+    eos_scores = jnp.where(eos_allowed, logp[:, :, eos_id], NEG_INF)  # [B,K]
+    eos_words = jnp.where(t_hot[:, None, :], jnp.int32(eos_id), s.live_words)
+    eos_len = s.live_len + 1
+    # the eos word was emitted from THIS step's attention
+    eos_alphas = jnp.where(
+        t_hot[:, None, :, None], step_alpha[:, :, None, :], s.live_alphas
+    )
+    cand_logp = jnp.concatenate([s.fin_logp, eos_scores], axis=1)   # [B,2K]
+    cand_words = jnp.concatenate([s.fin_words, eos_words], axis=1)  # [B,2K,T]
+    cand_len = jnp.concatenate([s.fin_len, eos_len], axis=1)
+    cand_alphas = jnp.concatenate([s.fin_alphas, eos_alphas], axis=1)
+    top_fin, fin_sel = jax.lax.top_k(cand_logp, K)
+    fin_logp = top_fin
+    fin_words = cand_words[batch_idx, fin_sel]
+    fin_len = cand_len[batch_idx, fin_sel]
+    fin_alphas = cand_alphas[batch_idx, fin_sel]
+
+    # --- continuations: global top-K over beam×vocab, eos excluded
+    cont = logp.at[:, :, eos_id].set(NEG_INF).reshape(B, K * V)
+    top_live, flat_sel = jax.lax.top_k(cont, K)            # [B,K]
+    parent = flat_sel // V                                 # source beam
+    word = (flat_sel % V).astype(jnp.int32)                # chosen token
+
+    gather_bk = lambda x: x.reshape(B, K, -1)[batch_idx, parent]  # noqa: E731
+    state = DecoderState(
+        memory=gather_bk(new_state.memory).reshape(B * K, H),
+        output=gather_bk(new_state.output).reshape(B * K, H),
+        recurrent=gather_bk(new_state.recurrent).reshape(B * K, H),
+    )
+    live_words = jnp.where(
+        t_hot[:, None, :], word[:, :, None], s.live_words[batch_idx, parent]
+    )
+    live_len = s.live_len[batch_idx, parent] + 1
+    live_alphas = jnp.where(
+        t_hot[:, None, :, None],
+        step_alpha[batch_idx, parent][:, :, None, :],
+        s.live_alphas[batch_idx, parent],
+    )
+    return state, SearchState(
+        live_logp=top_live,
+        live_words=live_words,
+        live_len=live_len,
+        last_word=word,
+        fin_logp=fin_logp,
+        fin_words=fin_words,
+        fin_len=fin_len,
+        live_alphas=live_alphas,
+        fin_alphas=fin_alphas,
+    )
+
+
+def _sealed(fin_logp: jnp.ndarray, live_logp: jnp.ndarray) -> jnp.ndarray:
+    """[B] bool: which rows' results can no longer change.  Cumulative
+    scores are sums of log-probs, so a live beam's score can only FALL.
+    Once a row has all K finished slots filled and its worst finished
+    caption outranks its best live beam, no later step can alter its
+    merged result (a new completion scores below min(fin) and the merge
+    ranks finished first)."""
+    return jnp.all(fin_logp > NEG_INF / 2, axis=1) & (
+        fin_logp.min(axis=1) >= live_logp.max(axis=1)
+    )
+
+
+def _merge_results(
+    s: SearchState,
+    K: int,
+    return_alphas: bool,
+    steps: Optional[jnp.ndarray] = None,
+) -> BeamResult:
+    """Final ranking: completed captions first (the reference only falls
+    back to partials when NOTHING completed, base_model.py:236-237); any
+    fin slots that never filled are backfilled per-slot from the live
+    partial beams instead of surfacing -inf junk rows."""
+    B = s.live_logp.shape[0]
+    batch_idx = jnp.arange(B)[:, None]
+    fin_valid = s.fin_logp > NEG_INF / 2
+    rank_key = jnp.concatenate(
+        [
+            jnp.where(fin_valid, s.fin_logp + _FINISHED_RANK_BONUS, NEG_INF),
+            s.live_logp,
+        ],
+        axis=1,
+    )                                                       # [B,2K]
+    cand_logp = jnp.concatenate([s.fin_logp, s.live_logp], axis=1)
+    cand_words = jnp.concatenate([s.fin_words, s.live_words], axis=1)
+    cand_len = jnp.concatenate([s.fin_len, s.live_len], axis=1)
+    _, sel = jax.lax.top_k(rank_key, K)                     # [B,K]
+    alphas = None
+    if return_alphas:
+        cand_alphas = jnp.concatenate([s.fin_alphas, s.live_alphas], axis=1)
+        alphas = cand_alphas[batch_idx, sel]
+    return BeamResult(
+        words=cand_words[batch_idx, sel],
+        log_scores=cand_logp[batch_idx, sel],
+        lengths=cand_len[batch_idx, sel],
+        alphas=alphas,
+        steps_run=steps,
+    )
 
 
 def run_search(
@@ -98,136 +288,38 @@ def run_search(
     K = beam_size or config.beam_size
     T = max_len or config.max_caption_length
     V = config.vocabulary_size
-    state = state0
-    H = state.output.shape[-1]
-
-    # beam 0 alive at logp 0; others dead so step 0 expands a single beam
-    live_logp = jnp.full((B, K), NEG_INF, jnp.float32).at[:, 0].set(0.0)
-    live_words = jnp.zeros((B, K, T), jnp.int32)
-    live_len = jnp.zeros((B, K), jnp.int32)
-    last_word = jnp.zeros((B, K), jnp.int32)  # <start> = 0 (model.py:253)
-
-    fin_logp = jnp.full((B, K), NEG_INF, jnp.float32)
-    fin_words = jnp.zeros((B, K, T), jnp.int32)
-    fin_len = jnp.zeros((B, K), jnp.int32)
 
     # per-step attention maps of every hypothesis; zero-width unless
     # requested, so the carry copies cost nothing in the default path
     if return_alphas and alpha_width is None:
         raise ValueError("return_alphas requires alpha_width")
     An = (alpha_width or 0) if return_alphas else 0
-    live_alphas = jnp.zeros((B, K, T, An), jnp.float32)
-    fin_alphas = jnp.zeros((B, K, T, An), jnp.float32)
-
-    batch_idx = jnp.arange(B)[:, None]  # [B,1] for beam gathers
+    search0 = _init_search(B, K, T, An)
 
     def body(loop_carry):
-        t, carry = loop_carry
-        (state, live_logp, live_words, live_len, last_word,
-         fin_logp, fin_words, fin_len, live_alphas, fin_alphas) = carry
-
-        new_state, logits, alpha = step_fn(state, last_word.reshape(B * K))
-        step_alpha = alpha.reshape(B, K, -1)[:, :, :An]          # [B,K,An]
-        if valid_size is not None and valid_size < V:
-            logits = logits.at[:, valid_size:].set(NEG_INF)
-        step_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        step_logp = step_logp.reshape(B, K, V)
-        logp = step_logp + live_logp[..., None]               # [B,K,V] cumulative
-
-        # --- completions: an eos hypothesis only becomes a candidate when
-        # eos is within its beam's top-(K+1) next words — the reference only
-        # ever pushes words from that set (base_model.py:219-230), so junk
-        # completions can't crowd out the partial-beam fallback.
-        kth = jax.lax.top_k(step_logp, min(K + 1, V))[0][..., -1]   # [B,K]
-        eos_allowed = step_logp[:, :, eos_id] >= kth
-        eos_scores = jnp.where(eos_allowed, logp[:, :, eos_id], NEG_INF)  # [B,K]
-        eos_words = live_words.at[:, :, t].set(
-            jnp.full((B, K), eos_id, jnp.int32)
+        t, (state, s) = loop_carry
+        new_state, logits, alpha = step_fn(state, s.last_word.reshape(B * K))
+        t_vec = jnp.full((B,), t, jnp.int32)
+        state, s = _expand_step(
+            eos_id, K, V, An, valid_size, new_state, logits, alpha, t_vec, s
         )
-        eos_len = live_len + 1
-        # the eos word was emitted from THIS step's attention
-        eos_alphas = live_alphas.at[:, :, t].set(step_alpha)
-        cand_logp = jnp.concatenate([fin_logp, eos_scores], axis=1)      # [B,2K]
-        cand_words = jnp.concatenate([fin_words, eos_words], axis=1)     # [B,2K,T]
-        cand_len = jnp.concatenate([fin_len, eos_len], axis=1)
-        cand_alphas = jnp.concatenate([fin_alphas, eos_alphas], axis=1)
-        top_fin, fin_sel = jax.lax.top_k(cand_logp, K)
-        fin_logp = top_fin
-        fin_words = cand_words[batch_idx, fin_sel]
-        fin_len = cand_len[batch_idx, fin_sel]
-        fin_alphas = cand_alphas[batch_idx, fin_sel]
-
-        # --- continuations: global top-K over beam×vocab, eos excluded
-        cont = logp.at[:, :, eos_id].set(NEG_INF).reshape(B, K * V)
-        top_live, flat_sel = jax.lax.top_k(cont, K)            # [B,K]
-        parent = flat_sel // V                                 # source beam
-        word = (flat_sel % V).astype(jnp.int32)                # chosen token
-
-        gather_bk = lambda x: x.reshape(B, K, -1)[batch_idx, parent]  # noqa: E731
-        state = DecoderState(
-            memory=gather_bk(new_state.memory).reshape(B * K, H),
-            output=gather_bk(new_state.output).reshape(B * K, H),
-            recurrent=gather_bk(new_state.recurrent).reshape(B * K, H),
-        )
-        live_words = live_words[batch_idx, parent].at[:, :, t].set(word)
-        live_len = live_len[batch_idx, parent] + 1
-        live_alphas = live_alphas[batch_idx, parent].at[:, :, t].set(
-            step_alpha[batch_idx, parent]
-        )
-        live_logp = top_live
-        last_word = word
-
-        return t + 1, (state, live_logp, live_words, live_len, last_word,
-                       fin_logp, fin_words, fin_len, live_alphas, fin_alphas)
+        return t + 1, (state, s)
 
     def cond(loop_carry):
-        t, carry = loop_carry
-        live_logp, fin_logp = carry[1], carry[5]
+        t, (_, s) = loop_carry
         if not early_exit:
             return t < T
-        # Exact early exit: cumulative scores are sums of log-probs, so a
-        # live beam's score can only FALL.  Once an image has all K
-        # finished slots filled and its worst finished caption outranks
-        # its best live beam, no later step can alter its result (a new
-        # completion scores below min(fin) and the merge ranks finished
-        # first) — when every image is in that state, stop.  Mean COCO
-        # captions run well short of T=20 (reference filter ≤20,
-        # coco.py:323-339), so this saves real decode steps with
-        # bit-identical results (pinned by tests).
-        image_done = jnp.all(fin_logp > NEG_INF / 2, axis=1) & (
-            fin_logp.min(axis=1) >= live_logp.max(axis=1)
-        )
-        return (t < T) & ~jnp.all(image_done)
+        # Exact early exit (see _sealed).  Mean COCO captions run well
+        # short of T=20 (reference filter ≤20, coco.py:323-339), so this
+        # saves real decode steps with bit-identical results (pinned by
+        # tests).
+        return (t < T) & ~jnp.all(_sealed(s.fin_logp, s.live_logp))
 
-    carry = (state, live_logp, live_words, live_len, last_word,
-             fin_logp, fin_words, fin_len, live_alphas, fin_alphas)
-    t_final, carry = jax.lax.while_loop(cond, body, (jnp.int32(0), carry))
-    (_, live_logp, live_words, live_len, _,
-     fin_logp, fin_words, fin_len, live_alphas, fin_alphas) = carry
-
-    # Merge: completed captions first (the reference only falls back to
-    # partials when NOTHING completed, base_model.py:236-237); any fin
-    # slots that never filled are backfilled per-slot from the live
-    # partial beams instead of surfacing -inf junk rows.
-    fin_valid = fin_logp > NEG_INF / 2
-    rank_key = jnp.concatenate(
-        [jnp.where(fin_valid, fin_logp + _FINISHED_RANK_BONUS, NEG_INF), live_logp],
-        axis=1,
-    )                                                       # [B,2K]
-    cand_logp = jnp.concatenate([fin_logp, live_logp], axis=1)
-    cand_words = jnp.concatenate([fin_words, live_words], axis=1)
-    cand_len = jnp.concatenate([fin_len, live_len], axis=1)
-    _, sel = jax.lax.top_k(rank_key, K)                     # [B,K]
-    alphas = None
-    if return_alphas:
-        cand_alphas = jnp.concatenate([fin_alphas, live_alphas], axis=1)
-        alphas = cand_alphas[batch_idx, sel]
-    return BeamResult(
-        words=cand_words[batch_idx, sel],
-        log_scores=cand_logp[batch_idx, sel],
-        lengths=cand_len[batch_idx, sel],
-        alphas=alphas,
-        steps_run=t_final if return_steps else None,
+    t_final, (_, search) = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), (state0, search0))
+    )
+    return _merge_results(
+        search, K, return_alphas, steps=t_final if return_steps else None
     )
 
 
@@ -308,16 +400,17 @@ def beam_search(
     jax.jit,
     static_argnames=(
         "config", "eos_id", "beam_size", "max_len", "valid_size",
-        "return_alphas", "early_exit",
+        "return_alphas", "early_exit", "return_steps",
     ),
 )
 def beam_search_jit(
     params, config, contexts, eos_id, beam_size=None, max_len=None,
-    valid_size=None, return_alphas=False, early_exit=True,
+    valid_size=None, return_alphas=False, early_exit=True, return_steps=False,
 ):
     return beam_search(
         params, config, contexts, eos_id, beam_size, max_len, valid_size,
         return_alphas=return_alphas, early_exit=early_exit,
+        return_steps=return_steps,
     )
 
 
@@ -328,9 +421,215 @@ def greedy_decode(
     eos_id: int,
     max_len: Optional[int] = None,
     valid_size: Optional[int] = None,
+    return_steps: bool = False,
 ) -> BeamResult:
     """Argmax decoding — the degenerate beam=1 case."""
     return beam_search(
         params, config, contexts, eos_id,
         beam_size=1, max_len=max_len, valid_size=valid_size,
+        return_steps=return_steps,
     )
+
+
+# ---------------------------------------------------------------------------
+# Resumable stepped decode — the serve engine's continuous-batching path
+# ---------------------------------------------------------------------------
+
+
+class SlotCarry(NamedTuple):
+    """Full resumable state of an S-slot decode pool.
+
+    Every leaf has a fixed shape for a given pool geometry, so one AOT
+    compile of each pool program (``init_slots`` / ``decode_step`` /
+    ``retire_slots`` / ``harvest_slots``) serves the pool's whole
+    lifetime — the serving zero-recompile guarantee extends to the
+    stepped path unchanged.  Slots advance independently: ``t`` is each
+    slot's own time index and ``alive`` its in-flight flag; inactive
+    rows pass through every program untouched (one-hot selects only —
+    no scatter at traced offsets anywhere).
+    """
+
+    ctx: jnp.ndarray        # [S*K, N, D] per-slot context grid, K-tiled
+    ctx_proj: jnp.ndarray   # [S*K, N] or [S*K, N, da] hoisted attention
+    state: DecoderState     # [S*K, H] LSTM carry
+    search: SearchState     # [S, ...] beam bookkeeping
+    t: jnp.ndarray          # [S] int32 per-slot time index
+    alive: jnp.ndarray      # [S] bool — seeded and not yet finished
+
+
+def init_slot_pool(
+    config: Config,
+    slots: int,
+    beam_size: Optional[int] = None,
+    max_len: Optional[int] = None,
+    return_alphas: bool = False,
+    alpha_width: Optional[int] = None,
+) -> SlotCarry:
+    """An empty pool: all slots dead, all state zeroed."""
+    K = beam_size or config.beam_size
+    T = max_len or config.max_caption_length
+    N, D, H = config.num_ctx, config.dim_ctx, config.num_lstm_units
+    An = (alpha_width or N) if return_alphas else 0
+    S = int(slots)
+    if config.num_attend_layers == 1:
+        ctx_proj = jnp.zeros((S * K, N), jnp.float32)
+    else:
+        ctx_proj = jnp.zeros((S * K, N, config.dim_attend_layer), jnp.float32)
+    return SlotCarry(
+        ctx=jnp.zeros((S * K, N, D), jnp.float32),
+        ctx_proj=ctx_proj,
+        state=DecoderState(
+            memory=jnp.zeros((S * K, H), jnp.float32),
+            output=jnp.zeros((S * K, H), jnp.float32),
+            recurrent=jnp.zeros((S * K, H), jnp.float32),
+        ),
+        search=_init_search(S, K, T, An),
+        t=jnp.zeros((S,), jnp.int32),
+        alive=jnp.zeros((S,), jnp.bool_),
+    )
+
+
+def init_slots(
+    params,
+    config: Config,
+    carry: SlotCarry,
+    lane_ctx: jnp.ndarray,
+    slot_src: jnp.ndarray,
+    admit_mask: jnp.ndarray,
+    beam_size: Optional[int] = None,
+) -> SlotCarry:
+    """Seed slots anywhere in the pool from an encoded admission lane.
+
+    lane_ctx: [L, N, D] — one encoder output per freshly admitted image
+    (L is the lane width the encoder was compiled at, ≤ page_width).
+    slot_src: [S] int32 — which lane row feeds each slot (gathered, so
+    scattered free slots seed from one contiguous encode; rows of
+    non-admitted slots are ignored — point them at 0).  admit_mask: [S]
+    bool — True slots are (re)initialized to a fresh t=0 search over
+    their lane context; False slots keep whatever state they held.
+
+    The gather + full-pool select keeps this ONE compiled program per
+    lane width regardless of which slots the host hands out, and the
+    expensive encode runs at lane width while the cheap per-slot init
+    (fc layers, beam bookkeeping) runs pool-wide.
+    """
+    K = beam_size or config.beam_size
+    S = carry.t.shape[0]
+    T = carry.search.live_words.shape[2]
+    An = carry.search.live_alphas.shape[3]
+
+    contexts = lane_ctx[slot_src]                               # [S, N, D]
+    ctx_new = tile_beams(contexts, K)
+    proj_new = tile_beams(precompute_attend(params, config, contexts), K)
+    st = init_state(params, config, contexts, train=False)      # [S, H]
+    st = DecoderState(*(tile_beams(x, K) for x in st))          # [S*K, H]
+    fresh = _init_search(S, K, T, An)
+
+    row_mask = jnp.repeat(admit_mask, K)                        # [S*K]
+
+    def sel(new, old, mask):
+        return jnp.where(
+            mask.reshape(mask.shape + (1,) * (old.ndim - 1)), new, old
+        )
+
+    return SlotCarry(
+        ctx=sel(ctx_new, carry.ctx, row_mask),
+        ctx_proj=sel(proj_new, carry.ctx_proj, row_mask),
+        state=DecoderState(
+            *(sel(n, o, row_mask) for n, o in zip(st, carry.state))
+        ),
+        search=SearchState(
+            *(sel(n, o, admit_mask) for n, o in zip(fresh, carry.search))
+        ),
+        t=sel(jnp.zeros((S,), jnp.int32), carry.t, admit_mask),
+        alive=sel(jnp.ones((S,), jnp.bool_), carry.alive, admit_mask),
+    )
+
+
+def decode_step(
+    params,
+    config: Config,
+    carry: SlotCarry,
+    slot_mask: jnp.ndarray,
+    eos_id: int,
+    beam_size: Optional[int] = None,
+    valid_size: Optional[int] = None,
+) -> tuple:
+    """Advance every active slot by one decode step.
+
+    slot_mask: [S] bool — the host's view of which slots hold in-flight
+    requests; a slot only advances when both slot_mask and carry.alive
+    are set, so harvested-but-not-yet-reseeded slots stay frozen.
+
+    Returns ``(carry, done)`` where done [S] bool flags slots that
+    finished THIS step — sealed by the exact early-exit condition (same
+    :func:`_sealed` the monolithic path uses) or out of time (t == T).
+    The decoder runs over all S*K rows every step (dead rows compute
+    garbage that one-hot selects discard); with bucket-sized pools this
+    is the same arithmetic the monolithic batch spends on padding.
+    """
+    K = beam_size or config.beam_size
+    S = carry.t.shape[0]
+    T = carry.search.live_words.shape[2]
+    V = config.vocabulary_size
+    An = carry.search.live_alphas.shape[3]
+    active = slot_mask & carry.alive                             # [S]
+
+    new_state, logits, alpha = decoder_step(
+        params, config, carry.ctx, carry.state,
+        carry.search.last_word.reshape(S * K),
+        train=False, ctx_proj=carry.ctx_proj,
+    )
+    g_state, stepped = _expand_step(
+        eos_id, K, V, An, valid_size, new_state, logits, alpha,
+        carry.t, carry.search,
+    )
+
+    # freeze everything in non-active slots — including sealed ones, whose
+    # results must hold bitwise until the host harvests them
+    row_active = jnp.repeat(active, K)                           # [S*K]
+
+    def sel_rows(new, old):
+        return jnp.where(
+            row_active.reshape((S * K,) + (1,) * (old.ndim - 1)), new, old
+        )
+
+    def sel_slot(new, old):
+        return jnp.where(
+            active.reshape((S,) + (1,) * (old.ndim - 1)), new, old
+        )
+
+    state = DecoderState(
+        *(sel_rows(n, o) for n, o in zip(g_state, carry.state))
+    )
+    search = SearchState(
+        *(sel_slot(n, o) for n, o in zip(stepped, carry.search))
+    )
+    t = jnp.where(active, carry.t + 1, carry.t)
+    sealed = _sealed(search.fin_logp, search.live_logp)
+    alive = jnp.where(active, ~sealed & (t < T), carry.alive)
+    done = active & ~alive
+    return (
+        SlotCarry(
+            ctx=carry.ctx, ctx_proj=carry.ctx_proj, state=state,
+            search=search, t=t, alive=alive,
+        ),
+        done,
+    )
+
+
+def retire_slots(carry: SlotCarry, retire_mask: jnp.ndarray) -> SlotCarry:
+    """Mark slots dead after harvest (idempotent — ``decode_step`` already
+    cleared ``alive`` for sealed slots; this also covers cancelling a
+    still-running slot, e.g. a request whose client gave up)."""
+    return carry._replace(alive=carry.alive & ~retire_mask)
+
+
+def harvest_slots(
+    carry: SlotCarry, return_alphas: bool = False
+) -> BeamResult:
+    """Merge every slot's finished/live beams into ranked results [S, ...]
+    (the host slices the done rows).  steps_run is the per-slot [S] step
+    count — the continuous path's decode_steps observability probe."""
+    K = carry.search.live_logp.shape[1]
+    return _merge_results(carry.search, K, return_alphas, steps=carry.t)

@@ -4,25 +4,34 @@ The first request-driven workload in the codebase: frozen params loaded
 through the resilience lineage, ``encode + beam_search`` AOT-compiled at
 a fixed ladder of batch buckets so steady state never recompiles, a
 dynamic micro-batcher with admission control, and a stdlib HTTP frontend
-with graceful SIGTERM drain.
+with graceful SIGTERM drain.  ``serve_mode="continuous"`` swaps the
+whole-batch dispatch for step-level continuous batching over a paged
+slot pool (same zero-recompile guarantee, bitwise-identical results).
 
 Layering:
 
-* :mod:`engine`  — lineage param load, AOT bucket warmup, pad-to-bucket
+* :mod:`engine`    — lineage param load, AOT bucket warmup, pad-to-bucket
   dispatch through compiled executables, detokenize drain;
-* :mod:`batcher` — bounded queue, max_batch/max_wait_ms gathering,
-  deadlines, 429 shed, double-buffered dispatch chain;
-* :mod:`server`  — ThreadingHTTPServer frontend (POST /caption,
+* :mod:`slot_pool` — fixed-capacity paged slot pool for the stepped
+  decode: AOT-warmed seed/step/harvest programs + host slot bookkeeping;
+* :mod:`batcher`   — bounded queue and admission control; MicroBatcher
+  gathers whole padded batches, ContinuousBatcher admits into free slots
+  between decode steps and detokenizes asynchronously;
+* :mod:`server`    — ThreadingHTTPServer frontend (POST /caption,
   GET /healthz, GET /stats), drain sequencing, the ``serve()`` CLI entry.
 """
 
-from .batcher import MicroBatcher, Rejected, Request
-from .engine import ServeEngine, load_serving_state
+from .batcher import ContinuousBatcher, MicroBatcher, Rejected, Request
+from .engine import BucketOverflow, ServeEngine, load_serving_state
 from .server import CaptionServer, serve
+from .slot_pool import PagedSlotPool
 
 __all__ = [
+    "BucketOverflow",
     "CaptionServer",
+    "ContinuousBatcher",
     "MicroBatcher",
+    "PagedSlotPool",
     "Rejected",
     "Request",
     "ServeEngine",

@@ -1,27 +1,31 @@
-"""Dynamic micro-batcher: bounded admission queue → pad-to-bucket batches.
+"""Admission control + dispatch discipline for the captioning service.
 
-PERF.md's decode measurements show the single-program beam search is
-dispatch-latency-bound at production batch sizes — one more image in the
-batch is nearly free, one more dispatch is not.  The batcher converts
-that headroom into request throughput: requests accumulate in a bounded
-queue, the dispatch thread gathers up to ``max_batch`` of them (holding
-an underfull batch open at most ``max_wait_ms``), pads the batch to the
-engine's bucket ladder, and dispatches.
+Two batchers share one bounded-queue admission contract (429 shed on a
+full queue, 503 while draining, 504 deadline triage before device time):
 
-Admission control and flow:
+* :class:`MicroBatcher` — whole-batch dispatch (``serve_mode="batch"``):
+  requests accumulate in the queue, the dispatch thread gathers up to
+  ``max_batch`` of them (holding an underfull batch open at most
+  ``max_wait_ms``), pads to the engine's bucket ladder, and dispatches
+  one monolithic beam search per batch.  The dispatch chain is
+  double-buffered exactly like ``runtime.device_prefetch``: batch n+1 is
+  dispatched before batch n's results are drained, so host-side
+  detokenization overlaps device beam search.
 
-* a full queue sheds immediately — ``Rejected(429)`` — so overload turns
-  into fast client-visible backpressure instead of unbounded latency;
-* a request whose deadline passed while it queued fails fast with 504 at
-  the dispatch boundary, never spending device time on it;
-* ``drain()`` flips the batcher into reject-new mode (503), completes
-  everything already admitted — queued *and* in flight — then stops.
+* :class:`ContinuousBatcher` — step-level continuous batching
+  (``serve_mode="continuous"``): queued requests are admitted into free
+  slots of a :class:`~sat_tpu.serve.slot_pool.PagedSlotPool` *between
+  decode steps* — no hold-open window, no whole-batch barrier — and each
+  slot retires the step its early-exit condition fires, freeing capacity
+  for the next arrival mid-decode.  A request that arrives 1 ms after a
+  step starts waits one ~step, not one ~full decode; short captions stop
+  paying max-length cost.  Detokenization runs on its own worker thread
+  so the step loop never blocks on host string work.
 
-The dispatch chain is double-buffered exactly like
-``runtime.device_prefetch``: batch n+1 is dispatched to the device before
-batch n's results are drained, so host-side detokenization (and the HTTP
-threads' JPEG decoding) overlaps device beam search.  The only
-host↔device sync is the engine's ``decode_output`` drain.
+Both bound the per-dispatch device drain with the wedge watchdog
+(``serve_wedge_timeout_ms``): a drain the device never answers fails the
+in-flight requests with 500 and fires ``on_wedge`` (the server's
+degrade + re-warm hook) instead of stranding them.
 """
 
 from __future__ import annotations
@@ -37,6 +41,7 @@ import numpy as np
 
 from .. import telemetry
 from ..resilience import faultinject
+from .engine import BucketOverflow
 
 
 class _WedgeTimeout(Exception):
@@ -68,6 +73,9 @@ class Request:
     # gather loop pops this request; the trace rides along so the batcher
     # can attribute each phase to the originating X-Request-Id
     t_gather_ns: Optional[int] = None
+    # continuous mode: when this request's slot was seeded (decode-phase
+    # attribution runs from here to harvest)
+    t_admit_ns: Optional[int] = None
     trace: Optional[Any] = None
 
     def mark(self, phase: str, t0_ns: int, dur_ns: int) -> None:
@@ -79,40 +87,30 @@ class Request:
         self.done.set()
 
 
-class MicroBatcher:
+class _BatcherBase:
+    """Bounded-queue admission + lifecycle shared by both dispatch
+    disciplines; subclasses implement ``_loop``."""
+
     def __init__(
         self,
         engine,
-        max_batch: Optional[int] = None,
-        max_wait_ms: Optional[float] = None,
         queue_depth: Optional[int] = None,
         tel=None,
-        pipeline_depth: int = 1,
         on_wedge: Optional[Callable[[], None]] = None,
         wedge_timeout_ms: Optional[float] = None,
     ) -> None:
         config = engine.config
         self.engine = engine
-        self.max_batch = int(
-            max_batch if max_batch is not None else config.serve_max_batch
-        )
-        wait_ms = (
-            max_wait_ms if max_wait_ms is not None else config.serve_max_wait_ms
-        )
-        self.max_wait_s = wait_ms / 1e3
         depth = int(
             queue_depth if queue_depth is not None else config.serve_queue_depth
         )
         self._q: "queue.Queue[Request]" = queue.Queue(maxsize=depth)
         self._tel = tel if tel is not None else telemetry.get()
-        # in-flight dispatches held before draining (device_prefetch's
-        # ``ahead``); 0 degrades to fully synchronous dispatch→drain
-        self.pipeline_depth = max(0, int(pipeline_depth))
         # wedge containment (docs/SERVING.md degraded health): when > 0,
-        # the result drain of each in-flight batch is bounded — a batch
-        # the device never returns fails its requests with 500 instead of
-        # stranding them, and ``on_wedge`` (the server's degrade+re-warm
-        # hook) fires.  0 keeps the drain unbounded (the default).
+        # the result drain of each in-flight dispatch is bounded — a
+        # result the device never returns fails its requests with 500
+        # instead of stranding them, and ``on_wedge`` (the server's
+        # degrade+re-warm hook) fires.  0 keeps the drain unbounded.
         wedge_ms = (
             wedge_timeout_ms
             if wedge_timeout_ms is not None
@@ -124,7 +122,6 @@ class MicroBatcher:
         # captured once so the fire-once bookkeeping persists across
         # batches
         self._plan = faultinject.FaultPlan.from_env()
-        self._batch_index = 0  # 1-based, counted at dispatch
         self._draining = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -167,7 +164,7 @@ class MicroBatcher:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self) -> "MicroBatcher":
+    def start(self) -> "_BatcherBase":
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._loop, name="sat-serve-batcher", daemon=True
@@ -183,6 +180,69 @@ class MicroBatcher:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+
+    def _loop(self) -> None:  # pragma: no cover - subclasses implement
+        raise NotImplementedError
+
+    # -- wedge watchdog ----------------------------------------------------
+
+    def _bounded_decode(self, decode: Callable[[], Any]):
+        """Run ``decode`` in a helper thread bounded by
+        ``wedge_timeout_s``; raises :class:`_WedgeTimeout` when the device
+        never returns.  The helper is a daemon — a truly wedged drain
+        parks it forever, which is exactly the state the timeout reports
+        instead of sharing."""
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def _run():
+            try:
+                box["results"] = decode()
+            except BaseException as e:
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_run, name="sat-serve-drain", daemon=True)
+        t.start()
+        if not done.wait(timeout=self.wedge_timeout_s):
+            raise _WedgeTimeout()
+        if "error" in box:
+            raise box["error"]
+        return box["results"]
+
+
+class MicroBatcher(_BatcherBase):
+    def __init__(
+        self,
+        engine,
+        max_batch: Optional[int] = None,
+        max_wait_ms: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+        tel=None,
+        pipeline_depth: int = 1,
+        on_wedge: Optional[Callable[[], None]] = None,
+        wedge_timeout_ms: Optional[float] = None,
+    ) -> None:
+        super().__init__(
+            engine,
+            queue_depth=queue_depth,
+            tel=tel,
+            on_wedge=on_wedge,
+            wedge_timeout_ms=wedge_timeout_ms,
+        )
+        config = engine.config
+        self.max_batch = int(
+            max_batch if max_batch is not None else config.serve_max_batch
+        )
+        wait_ms = (
+            max_wait_ms if max_wait_ms is not None else config.serve_max_wait_ms
+        )
+        self.max_wait_s = wait_ms / 1e3
+        # in-flight dispatches held before draining (device_prefetch's
+        # ``ahead``); 0 degrades to fully synchronous dispatch→drain
+        self.pipeline_depth = max(0, int(pipeline_depth))
+        self._batch_index = 0  # 1-based, counted at dispatch
 
     # -- dispatch loop -----------------------------------------------------
 
@@ -248,31 +308,6 @@ class MicroBatcher:
             r.bucket = bucket
             r.mark("dispatch", t0, t1 - t0)
         return out
-
-    def _bounded_decode(self, decode: Callable[[], Any]):
-        """Run ``decode`` in a helper thread bounded by
-        ``wedge_timeout_s``; raises :class:`_WedgeTimeout` when the device
-        never returns.  The helper is a daemon — a truly wedged drain
-        parks it forever, which is exactly the state the timeout reports
-        instead of sharing."""
-        box: Dict[str, Any] = {}
-        done = threading.Event()
-
-        def _run():
-            try:
-                box["results"] = decode()
-            except BaseException as e:
-                box["error"] = e
-            finally:
-                done.set()
-
-        t = threading.Thread(target=_run, name="sat-serve-drain", daemon=True)
-        t.start()
-        if not done.wait(timeout=self.wedge_timeout_s):
-            raise _WedgeTimeout()
-        if "error" in box:
-            raise box["error"]
-        return box["results"]
 
     def _finish(self, entry) -> None:
         out, live, index = entry
@@ -350,6 +385,17 @@ class MicroBatcher:
                 continue
             try:
                 out = self._dispatch(live)
+            except BucketOverflow as e:
+                # a burst past the largest warmed bucket is backpressure,
+                # not a server fault: shed with 429 + a Retry-After hint
+                # (the frontend adds the header)
+                self._tel.count("serve/shed_bucket_overflow")
+                for r in live:
+                    r.fail(
+                        429,
+                        f"{e}; retry after the current batch drains",
+                    )
+                continue
             except Exception as e:  # device/shape failure: fail the batch
                 self._tel.count("serve/dispatch_errors")
                 for r in live:
@@ -361,3 +407,263 @@ class MicroBatcher:
                 self._finish(inflight.popleft())
         while inflight:  # drain: complete what the device still owes
             self._finish(inflight.popleft())
+
+
+class ContinuousBatcher(_BatcherBase):
+    """Step-level continuous batching over a paged slot pool.
+
+    The loop interleaves three phases with no whole-batch barrier:
+
+    1. **admit** — pop whatever is queued (up to the pool's free slots),
+       triage deadlines, seed a page per block of new requests;
+    2. **step** — one ``decode_step`` dispatch over the pool; draining
+       the [S] done flags is the loop's only host↔device sync, bounded
+       by the wedge watchdog;
+    3. **harvest** — merge + drain finished slots, free them, and hand
+       the host arrays to the detok worker thread (string work never
+       blocks the step loop).
+
+    All device programs are AOT executables owned by the pool, so steady
+    state never recompiles (asserted by tests/test_continuous.py)."""
+
+    def __init__(
+        self,
+        engine,
+        pool=None,
+        queue_depth: Optional[int] = None,
+        tel=None,
+        on_wedge: Optional[Callable[[], None]] = None,
+        wedge_timeout_ms: Optional[float] = None,
+    ) -> None:
+        super().__init__(
+            engine,
+            queue_depth=queue_depth,
+            tel=tel,
+            on_wedge=on_wedge,
+            wedge_timeout_ms=wedge_timeout_ms,
+        )
+        if pool is None:
+            from .slot_pool import PagedSlotPool
+
+            pool = PagedSlotPool(engine, tel=self._tel)
+        self.pool = pool
+        self._step_index = 0  # 1-based; SAT_FI_WEDGE_SERVE_BATCH=n wedges step n
+        self._detok_q: "queue.Queue" = queue.Queue()
+        self._detok_thread: Optional[threading.Thread] = None
+        # re-warm requests are executed ON the loop thread (the pool is
+        # single-owner; a concurrent warmup would race admission)
+        self._rewarm_q: "queue.Queue[threading.Event]" = queue.Queue()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ContinuousBatcher":
+        if self.pool._carry is None:
+            self.pool.warmup()
+        if self._detok_thread is None:
+            self._detok_thread = threading.Thread(
+                target=self._detok_loop, name="sat-serve-detok", daemon=True
+            )
+            self._detok_thread.start()
+        super().start()
+        return self
+
+    # -- admission into slots ----------------------------------------------
+
+    def _pop_queued(self, cap: int) -> List[Request]:
+        out: List[Request] = []
+        while len(out) < cap:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    def _admit(self, reqs: List[Request]) -> None:
+        """Deadline triage + seed into free slots, marking per-request
+        admission phases.  Callers never pass more than free_count()."""
+        now_ns = time.perf_counter_ns()
+        now_unix = time.time()
+        items = []
+        for r in reqs:
+            r.t_gather_ns = now_ns
+            self._tel.record(
+                "serve/queue_wait", r.t_submit_ns, now_ns - r.t_submit_ns
+            )
+            r.mark("queue_wait", r.t_submit_ns, now_ns - r.t_submit_ns)
+            if r.deadline_unix is not None and now_unix > r.deadline_unix:
+                self._tel.count("serve/expired")
+                r.fail(504, "deadline expired while queued")
+            else:
+                items.append((r.image, r))
+        if not items:
+            return
+        t0 = time.perf_counter_ns()
+        n = self.pool.admit(items)
+        t1 = time.perf_counter_ns()
+        self._tel.count("serve/admitted", n)
+        for _, r in items[:n]:
+            # the page width is the continuous path's dispatch "bucket"
+            r.bucket = self.pool.width
+            r.t_admit_ns = t1
+            r.mark("admit", t0, t1 - t0)
+            # submit → seeded: the continuous path's admission latency
+            # (what max_wait_ms + whole-batch gathering used to cost)
+            self._tel.record(
+                "serve/admission_wait", r.t_submit_ns, t1 - r.t_submit_ns
+            )
+        for _, r in items[n:]:  # unreachable by construction; never strand
+            r.fail(500, "slot pool admission overflow")
+        self._tel.gauge("serve/queue_depth", self._q.qsize())
+
+    # -- the step loop -----------------------------------------------------
+
+    def _step_and_drain(self, index: int) -> np.ndarray:
+        if self._plan.maybe_wedge_serve(index):
+            # injected stuck step: park exactly like a drain whose device
+            # never answers (interruptible only by process exit)
+            time.sleep(3600.0)
+        self._plan.maybe_slow_serve()
+        t0 = time.perf_counter_ns()
+        done_dev = self.pool.step()
+        done = np.asarray(done_dev)  # sync-ok: step boundary — the continuous loop's one bounded sync
+        self._tel.record("serve/step", t0, time.perf_counter_ns() - t0)
+        self._tel.count("serve/steps")
+        return done
+
+    def _fail_inflight(self, status: int, reason: str) -> None:
+        for r in self.pool.inflight_payloads():
+            if not r.done.is_set():
+                r.fail(status, reason)
+
+    def _handle_wedge(self) -> None:
+        # same counter the batch path trips, so /healthz consumers and
+        # the chaos campaign see one wedge signal across modes
+        self._tel.count("serve/wedged_batches")
+        self._fail_inflight(
+            500,
+            "in-flight decode step wedged past "
+            f"{self.wedge_timeout_s * 1e3:g}ms; slots discarded",
+        )
+        try:
+            self.pool.reset()
+        except Exception:
+            pass  # a reset the device won't answer is the wedge itself
+        if self.on_wedge is not None:
+            try:
+                self.on_wedge()
+            except Exception:
+                pass  # degrading health must never kill the batcher
+
+    def _harvest(self, done: np.ndarray) -> None:
+        t0 = time.perf_counter_ns()
+        payloads, words, lengths, scores, steps = self.pool.harvest(done)
+        t1 = time.perf_counter_ns()
+        for i, r in enumerate(payloads):
+            r.mark("drain", t0, t1 - t0)
+            if r.t_admit_ns is not None:
+                r.mark("decode", r.t_admit_ns, t1 - r.t_admit_ns)
+            # raw per-request loop-iteration count (not ns): short
+            # captions SHOW their early retirement here
+            self._tel.record("serve/decode_steps", 0, int(steps[i]))
+        self._detok_q.put((payloads, words, lengths, scores, t1))
+
+    def _detok_loop(self) -> None:
+        while True:
+            item = self._detok_q.get()
+            if item is None:
+                return
+            payloads, words, lengths, scores, t1 = item
+            try:
+                results = self.engine.detok_rows(
+                    (words, lengths, scores), len(payloads)
+                )
+            except Exception as e:
+                self._tel.count("serve/detok_errors")
+                for r in payloads:
+                    if not r.done.is_set():
+                        r.fail(500, f"detokenize failed: {e}")
+                continue
+            t2 = time.perf_counter_ns()
+            self._tel.record("serve/detok", t1, t2 - t1)
+            for r, result in zip(payloads, results):
+                r.mark("detok", t1, t2 - t1)
+                r.result = result
+                r.done.set()
+                self._tel.count("serve/completed")
+
+    def _maybe_rewarm(self) -> None:
+        try:
+            ev = self._rewarm_q.get_nowait()
+        except queue.Empty:
+            return
+        # anything still bound was admitted during the degraded window;
+        # warmup rebuilds an empty carry, so hand them a retryable 503
+        # rather than silently dropping their slots
+        self._fail_inflight(503, "server re-warming after wedge; retry")
+        try:
+            self.pool.warmup()
+        finally:
+            ev.set()
+
+    def _loop(self) -> None:
+        while True:
+            self._maybe_rewarm()
+            if self.pool.occupancy() == 0:
+                # idle: park for the first arrival, polling the drain flag
+                try:
+                    first = self._q.get(timeout=0.05)
+                except queue.Empty:
+                    if self._draining.is_set():
+                        break
+                    continue
+                self._admit([first])
+            # admit whatever else is queued RIGHT NOW into free slots —
+            # between steps, with no hold-open window
+            cap = self.pool.free_count()
+            if cap > 0:
+                riders = self._pop_queued(cap)
+                if riders:
+                    self._admit(riders)
+            if self.pool.occupancy() == 0:
+                continue  # everything admitted expired at the deadline gate
+            self._step_index += 1
+            index = self._step_index
+            try:
+                if self.wedge_timeout_s > 0:
+                    done = self._bounded_decode(
+                        lambda: self._step_and_drain(index)
+                    )
+                else:
+                    done = self._step_and_drain(index)
+            except _WedgeTimeout:
+                self._handle_wedge()
+                continue
+            except Exception as e:  # keep serving; fail only in-flight work
+                self._tel.count("serve/dispatch_errors")
+                self._fail_inflight(500, f"decode step failed: {e}")
+                try:
+                    self.pool.reset()
+                except Exception:
+                    pass
+                continue
+            if done.any():
+                self._harvest(done)
+        # drain: queue empty and pool empty — flush the detok worker
+        self._detok_q.put(None)
+        if self._detok_thread is not None:
+            self._detok_thread.join(timeout=30.0)
+            self._detok_thread = None
+
+    def rewarm(self) -> None:
+        """The server's wedge-recovery hook: re-run the pool warmup
+        (cached compiles — cheap) and rebuild an empty carry, proving the
+        device answers before health recovers.  Executed on the loop
+        thread when it's alive — the pool is single-owner, and a warmup
+        racing admission would clobber freshly seeded slots."""
+        if self._thread is None or not self._thread.is_alive():
+            self.pool.warmup()
+            return
+        ev = threading.Event()
+        self._rewarm_q.put(ev)
+        if not ev.wait(timeout=120.0):
+            raise RuntimeError("slot-pool re-warm timed out")

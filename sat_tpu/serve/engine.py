@@ -44,6 +44,20 @@ from ..train.checkpoint import restore_checkpoint
 from ..train.step import create_train_state
 
 
+class BucketOverflow(ValueError):
+    """A batch larger than the largest warmed bucket.  An admission-side
+    overload signal, not a server fault: the frontend maps it to HTTP 429
+    with a Retry-After hint instead of a 500."""
+
+    def __init__(self, n: int, buckets: Sequence[int]):
+        super().__init__(
+            f"batch of {n} exceeds the largest warmed bucket "
+            f"{buckets[-1]} (serve_buckets={tuple(buckets)})"
+        )
+        self.n = n
+        self.largest = int(buckets[-1])
+
+
 def load_serving_state(config: Config, model_file: Optional[str] = None):
     """Frozen-param load for serving; returns ``(state, source)``.
 
@@ -153,6 +167,9 @@ class ServeEngine:
             beam_size=config.beam_size,
             valid_size=len(self.vocabulary.words),
             return_alphas=False,
+            # per-batch decode-step counts ride the result pytree and are
+            # drained with it — the serve/decode_steps observability probe
+            return_steps=True,
         )
         compiles0 = self._tel.counters().get("jax/compiles", 0)
         t0 = time.perf_counter()
@@ -189,10 +206,7 @@ class ServeEngine:
         for b in self.buckets:
             if b >= n:
                 return b
-        raise ValueError(
-            f"batch of {n} exceeds the largest warmed bucket "
-            f"{self.buckets[-1]} (serve_buckets={self.buckets})"
-        )
+        raise BucketOverflow(n, self.buckets)
 
     def pad_batch(self, images: List[np.ndarray]) -> Tuple[np.ndarray, int]:
         """Stack request images and zero-pad up to the chosen bucket.
@@ -237,6 +251,11 @@ class ServeEngine:
         words = np.asarray(out.words)[:n]  # sync-ok: serve detok boundary — batch results drained once
         lengths = np.asarray(out.lengths)[:n]  # sync-ok: serve detok boundary
         scores = np.asarray(out.log_scores)[:n]  # sync-ok: serve detok boundary
+        if out.steps_run is not None:
+            # raw loop-iteration count (not ns); /stats reports raw
+            # percentiles and the bench divides by request count
+            steps = int(np.asarray(out.steps_run))  # sync-ok: drained with the batch above
+            self._tel.record("serve/decode_steps", 0, steps)
         return words, lengths, scores
 
     def detok_rows(

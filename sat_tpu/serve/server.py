@@ -58,14 +58,16 @@ from ..telemetry import promtext, tracectx
 from ..telemetry.heartbeat import Heartbeat
 from ..telemetry.profwin import ProfileLatch
 from ..telemetry.slo import SLOEngine, objectives_from_config
-from .batcher import MicroBatcher, Rejected
+from .batcher import ContinuousBatcher, MicroBatcher, Rejected
 from .engine import ServeEngine, load_serving_state
+from .slot_pool import PagedSlotPool
 
 _LATENCY_SPANS = (
     "serve/request",
     "serve/queue_wait",
     "serve/preprocess",
     "serve/dispatch",
+    "serve/step",
     "serve/detok",
 )
 
@@ -88,6 +90,24 @@ def _percentiles_ms(tel, name: str) -> Optional[Dict[str, Any]]:
     }
 
 
+def _percentiles_raw(tel, name: str) -> Optional[Dict[str, Any]]:
+    """Like :func:`_percentiles_ms` but for spans that store raw counts
+    (serve/decode_steps records loop iterations, not nanoseconds)."""
+    data = np.asarray(tel.durations_ns(name), np.float64)  # sync-ok: host telemetry ring, not device data
+    if data.size == 0:
+        return None
+    data = np.sort(data)
+    def pct(p: float) -> float:
+        idx = min(data.size - 1, int(p / 100.0 * data.size))
+        return round(float(data[idx]), 3)  # sync-ok: host numpy percentile
+    return {
+        "count": int(data.size),
+        "p50": pct(50),
+        "p95": pct(95),
+        "p99": pct(99),
+    }
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "sat-serve"
@@ -98,18 +118,36 @@ class _Handler(BaseHTTPRequestHandler):
     def _request_id(self) -> str:
         return tracectx.ensure_id(self.headers.get(tracectx.TRACE_HEADER))
 
-    def _send(self, status: int, body: bytes, ctype: str, rid: str) -> None:
+    def _send(
+        self,
+        status: int,
+        body: bytes,
+        ctype: str,
+        rid: str,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         # EVERY reply carries the correlation id — sheds and 404s too,
         # so clients can correlate a reject with their own logs
         self.send_header(tracectx.TRACE_HEADER, rid)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _reply(self, status: int, payload: Dict[str, Any], rid: str) -> None:
-        self._send(status, json.dumps(payload).encode(), "application/json", rid)
+    def _reply(
+        self,
+        status: int,
+        payload: Dict[str, Any],
+        rid: str,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self._send(
+            status, json.dumps(payload).encode(), "application/json", rid,
+            headers=headers,
+        )
 
     def do_GET(self) -> None:
         app = self.server.app
@@ -171,7 +209,13 @@ class _Handler(BaseHTTPRequestHandler):
             deadline_ms=self.headers.get("X-Deadline-Ms"),
             request_id=rid,
         )
-        self._reply(status, payload, rid)
+        headers = None
+        if status == 429 and "retry_after_ms" in payload:
+            # RFC 7231 Retry-After is whole seconds; round up so a
+            # compliant client never comes back before the hint
+            secs = max(1, int(-(-payload["retry_after_ms"] // 1000)))
+            headers = {"Retry-After": str(secs)}
+        self._reply(status, payload, rid, headers=headers)
 
 
 class CaptionServer:
@@ -196,15 +240,32 @@ class CaptionServer:
         # admission knobs come from THIS server's config (which may be a
         # replace() of the engine's — e.g. a tighter queue for the same
         # warmed engine), not the engine's defaults
-        self.batcher = MicroBatcher(
-            engine,
-            max_batch=config.serve_max_batch,
-            max_wait_ms=config.serve_max_wait_ms,
-            queue_depth=config.serve_queue_depth,
-            tel=self._tel,
-            on_wedge=self._on_wedge,
-            wedge_timeout_ms=config.serve_wedge_timeout_ms,
-        )
+        self.pool: Optional[PagedSlotPool] = None
+        if config.serve_mode == "continuous":
+            self.pool = PagedSlotPool(
+                engine,
+                pages=config.serve_slot_pages,
+                page_width=config.serve_page_width,
+                tel=self._tel,
+            )
+            self.batcher = ContinuousBatcher(
+                engine,
+                pool=self.pool,
+                queue_depth=config.serve_queue_depth,
+                tel=self._tel,
+                on_wedge=self._on_wedge,
+                wedge_timeout_ms=config.serve_wedge_timeout_ms,
+            )
+        else:
+            self.batcher = MicroBatcher(
+                engine,
+                max_batch=config.serve_max_batch,
+                max_wait_ms=config.serve_max_wait_ms,
+                queue_depth=config.serve_queue_depth,
+                tel=self._tel,
+                on_wedge=self._on_wedge,
+                wedge_timeout_ms=config.serve_wedge_timeout_ms,
+            )
         self._host = host if host is not None else config.serve_host
         self._requested_port = (
             port if port is not None else config.serve_port
@@ -320,7 +381,10 @@ class CaptionServer:
                 image, deadline_unix=deadline_unix, trace=trace
             )
         except Rejected as e:
-            return self._finish_request(trace, e.status, {"error": e.reason})
+            payload = {"error": e.reason}
+            if e.status == 429:
+                payload["retry_after_ms"] = self._retry_hint_ms()
+            return self._finish_request(trace, e.status, payload)
         wait_s = (
             budget_ms / 1e3 + 5.0 if deadline_unix else self.DEFAULT_WAIT_S
         )
@@ -330,11 +394,11 @@ class CaptionServer:
                 trace, 504, {"error": "request timed out in service"}
             )
         if req.error is not None:
+            payload = {"error": req.error[1]}
+            if req.error[0] == 429:
+                payload["retry_after_ms"] = self._retry_hint_ms()
             return self._finish_request(
-                trace,
-                req.error[0],
-                {"error": req.error[1]},
-                bucket=req.bucket,
+                trace, req.error[0], payload, bucket=req.bucket
             )
         self._tel.record(
             "serve/request", t_req0, time.perf_counter_ns() - t_req0
@@ -343,6 +407,17 @@ class CaptionServer:
         payload["bucket"] = req.bucket
         payload["model_step"] = self.engine.step
         return self._finish_request(trace, 200, payload, bucket=req.bucket)
+
+    def _retry_hint_ms(self) -> int:
+        """Retry-After hint for 429 sheds: about one service period — the
+        observed p50 end-to-end latency when we have one, else twice the
+        batching window — clamped to a sane band so a cold server never
+        tells clients to hammer it or to go away for minutes."""
+        p = _percentiles_ms(self._tel, "serve/request")
+        hint = (
+            p["p50"] if p else 2.0 * max(1.0, self.config.serve_max_wait_ms)
+        )
+        return int(min(10_000.0, max(50.0, hint)))
 
     def healthz(self) -> Tuple[Dict[str, Any], int]:
         payload = self.heartbeat.payload() if self.heartbeat else {}
@@ -385,7 +460,12 @@ class CaptionServer:
 
     def _rewarm(self) -> None:
         try:
-            self.engine.warmup()
+            if self.config.serve_mode == "continuous":
+                # re-warm the slot pool (cached compiles) and rebuild the
+                # empty carry; in-flight slots were already failed
+                self.batcher.rewarm()
+            else:
+                self.engine.warmup()
         except Exception as e:
             # still wedged — stay degraded; the next wedge timeout (or an
             # operator) escalates
@@ -418,8 +498,9 @@ class CaptionServer:
             p = _percentiles_ms(self._tel, name)
             if p:
                 latency[name] = p
-        return {
+        out = {
             "ready": self._ready,
+            "serve_mode": self.config.serve_mode,
             "queue_depth": self.batcher.queue_depth(),
             "buckets": list(self.engine.buckets),
             "bucket_histogram": histogram,
@@ -435,11 +516,31 @@ class CaptionServer:
             "slo": self.slo.snapshot(),
             "profile_captures": self.profiles.captures,
         }
+        # raw loop-iteration counts, not ms — how many decode steps each
+        # request actually ran (continuous mode retires early; batch mode
+        # reports the per-batch monolithic step count)
+        steps = _percentiles_raw(self._tel, "serve/decode_steps")
+        if steps:
+            out["decode_steps"] = steps
+        if self.pool is not None:
+            out["slot_pool"] = {
+                "slots": self.pool.slots,
+                "pages": self.pool.pages,
+                "page_width": self.pool.width,
+                "busy": self.pool.occupancy(),
+            }
+        return out
 
     # -- observability endpoints -------------------------------------------
 
     def metrics_text(self) -> str:
         """The Prometheus exposition body for ``GET /metrics``."""
+        # refresh the decode-step distribution gauges at scrape time so
+        # both serve modes export them without a per-request hot-path cost
+        steps = _percentiles_raw(self._tel, "serve/decode_steps")
+        if steps:
+            self._tel.gauge("serve/decode_steps_p50", steps["p50"])
+            self._tel.gauge("serve/decode_steps_p95", steps["p95"])
         extra = self.heartbeat.payload() if self.heartbeat else None
         return promtext.render(self._tel, extra=extra)
 
@@ -586,7 +687,11 @@ def serve(config: Config, model_file: Optional[str] = None) -> int:
         file=sys.stderr,
         flush=True,
     )
-    engine.warmup()
+    if config.serve_mode == "batch":
+        # continuous mode warms the slot-pool programs instead (in
+        # ContinuousBatcher.start, via the server below) — the bucket
+        # ladder would be dead weight there
+        engine.warmup()
     server = CaptionServer(config, engine)
     # flight recorder (telemetry/blackbox.py): journal serve state so an
     # abnormal exit leaves a postmortem bundle like a training run's
@@ -603,11 +708,19 @@ def serve(config: Config, model_file: Optional[str] = None) -> int:
         )
         bb.event("serve_start", port=server.port, model_step=engine.step)
     server.start()
+    if config.serve_mode == "continuous":
+        geometry = (
+            f"slot pool {config.serve_slot_pages}x{config.serve_page_width}"
+        )
+    else:
+        geometry = (
+            f"buckets {engine.buckets}, max_batch {config.serve_max_batch}, "
+            f"max_wait {config.serve_max_wait_ms}ms"
+        )
     print(
         f"sat_tpu: captioning server listening on "
         f"http://{config.serve_host}:{server.port}  "
-        f"(buckets {engine.buckets}, max_batch {config.serve_max_batch}, "
-        f"max_wait {config.serve_max_wait_ms}ms)",
+        f"(mode {config.serve_mode}, {geometry})",
         file=sys.stderr,
         flush=True,
     )

@@ -1,0 +1,303 @@
+"""Fixed-capacity paged slot pool — device state for continuous batching.
+
+The TPU analogue of Ragged Paged Attention's block pool (PAPERS.md,
+arXiv:2604.15464): decode state lives in a fixed ``[slots, ...]`` carry
+(``ops.beam_search.SlotCarry``); admission runs through fixed-width
+**encode lanes** — the expensive encoder is AOT-compiled at each
+power-of-two width up to ``page_width``, a burst of admitted images is
+encoded at the smallest lane that fits (so a single straggler admission
+costs a 1-wide encode, not a padded full-page one), and one
+``init_slots`` gather-seed scatters the lane into whichever slots are
+free.  Every decode step is one ``decode_step`` dispatch over the whole
+pool; finished slots are merged by ``harvest_slots`` and freed.  All
+programs are AOT-compiled ONCE per pool geometry at warmup via
+``jit.lower(...).compile()`` — the serving zero-recompile guarantee
+extends to the stepped path unchanged.
+
+The pool owns device state and host bookkeeping (free-slot set, slot →
+request binding) only; scheduling policy — when to admit, when to step,
+the wedge watchdog — belongs to ``serve.batcher.ContinuousBatcher``.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+# the ops package re-exports the beam_search FUNCTION, which shadows the
+# submodule on attribute import — import the names directly
+from ..ops.beam_search import (
+    decode_step,
+    harvest_slots,
+    init_slot_pool,
+    init_slots,
+    retire_slots,
+)
+
+
+def _lane_widths(page_width: int) -> List[int]:
+    """Powers of two up to ``page_width``, plus ``page_width`` itself —
+    the fixed set of encode-lane shapes warmed at startup."""
+    widths = []
+    w = 1
+    while w < page_width:
+        widths.append(w)
+        w *= 2
+    widths.append(page_width)
+    return widths
+
+
+class PagedSlotPool:
+    """``pages × page_width`` decode slots over a ``ServeEngine``'s frozen
+    params.  Not thread-safe: one owner thread (the batcher loop) drives
+    admit/step/harvest."""
+
+    def __init__(
+        self,
+        engine,
+        pages: Optional[int] = None,
+        page_width: Optional[int] = None,
+        tel=None,
+    ) -> None:
+        config = engine.config
+        self.engine = engine
+        self.config = config
+        self.pages = int(
+            pages if pages is not None else config.serve_slot_pages
+        )
+        self.width = int(
+            page_width if page_width is not None else config.serve_page_width
+        )
+        self.slots = self.pages * self.width
+        self.beam_size = config.beam_size
+        self.max_len = config.max_caption_length
+        self.valid_size = len(engine.vocabulary.words)
+        self.eos_id = engine.eos_id
+        self._tel = tel if tel is not None else engine._tel
+        # host bookkeeping: free-slot set + slot -> opaque payload binding
+        # (the batcher binds its Request objects; the pool never looks
+        # inside them)
+        self._free = set(range(self.slots))
+        self._payload = {}
+        self._mask = np.zeros((self.slots,), np.bool_)
+        self._carry = None
+        self.lane_widths = _lane_widths(self.width)
+        self._enc_execs = {}
+        self._seed_execs = {}
+        self._reset_exec = None
+        self._step_exec = None
+        self._harvest_exec = None
+        self._retire_exec = None
+        self.warm_compiles = 0
+        self.warm_seconds = 0.0
+        self.compiles_at_ready = 0
+
+    # -- startup / recovery ------------------------------------------------
+
+    def warmup(self) -> None:
+        """AOT-compile the pool programs for this geometry and build the
+        empty carry.  Idempotent and cheap to re-run (persistent compile
+        cache) — the wedge re-warm path calls it again to prove the
+        device answers before health recovers."""
+        import jax
+
+        from ..models.captioner import encode
+
+        engine, config = self.engine, self.config
+        size = config.image_size
+        S, K = self.slots, self.beam_size
+
+        def encode_fn(variables, images):
+            contexts, _ = encode(variables, config, images, train=False)
+            return contexts
+
+        compiles0 = self._tel.counters().get("jax/compiles", 0)
+        t0 = time.perf_counter()
+
+        pool_statics = dict(
+            config=config, slots=S, beam_size=K, max_len=self.max_len
+        )
+        reset_jit = jax.jit(
+            init_slot_pool,
+            static_argnames=(
+                "config", "slots", "beam_size", "max_len",
+                "return_alphas", "alpha_width",
+            ),
+        )
+        self._reset_exec = reset_jit.lower(**pool_statics).compile()
+        # the concrete empty carry doubles as the sample argument for the
+        # remaining lowers (jax.eval_shape can't see static_argnames)
+        carry_sd = self._reset_exec()
+        mask_sd = jax.ShapeDtypeStruct((S,), np.bool_)
+        src_sd = jax.ShapeDtypeStruct((S,), np.int32)
+
+        enc_jit = jax.jit(encode_fn)
+        seed_jit = jax.jit(init_slots, static_argnames=("config", "beam_size"))
+        for L in self.lane_widths:
+            images_sd = jax.ShapeDtypeStruct(
+                (L, size, size, 3), engine._image_dtype
+            )
+            ctx_sd = jax.eval_shape(enc_jit, engine._variables, images_sd)
+            self._enc_execs[L] = enc_jit.lower(
+                engine._variables, images_sd
+            ).compile()
+            self._seed_execs[L] = seed_jit.lower(
+                engine._decoder_params, config, carry_sd, ctx_sd,
+                src_sd, mask_sd, beam_size=K,
+            ).compile()
+        self._step_exec = (
+            jax.jit(
+                decode_step,
+                static_argnames=("config", "eos_id", "beam_size", "valid_size"),
+            )
+            .lower(
+                engine._decoder_params, config, carry_sd, mask_sd,
+                self.eos_id, beam_size=K, valid_size=self.valid_size,
+            )
+            .compile()
+        )
+        self._harvest_exec = (
+            jax.jit(harvest_slots, static_argnames=("return_alphas",))
+            .lower(carry_sd)
+            .compile()
+        )
+        self._retire_exec = (
+            jax.jit(retire_slots).lower(carry_sd, mask_sd).compile()
+        )
+
+        self.reset()
+        jax.block_until_ready(self._carry.t)  # sync-ok: warmup, before ready — proves the device answers
+        self.warm_seconds = time.perf_counter() - t0
+        counters = self._tel.counters()
+        self.compiles_at_ready = counters.get("jax/compiles", 0)
+        self.warm_compiles = self.compiles_at_ready - compiles0
+        # extend the engine's zero-recompile ledger past the pool warmup
+        # so "compiles_since_ready" in /stats covers both paths
+        engine.compiles_at_ready = max(
+            engine.compiles_at_ready, self.compiles_at_ready
+        )
+        self._tel.gauge("serve/slot_pool_slots", self.slots)
+        self._tel.gauge("serve/slot_pool_pages", self.pages)
+        self._tel.gauge("serve/pool_warm_compiles", self.warm_compiles)
+        self._tel.gauge("serve/pool_warm_seconds", round(self.warm_seconds, 3))
+        print(
+            f"sat_tpu: slot pool warmup — {self.pages}x{self.width} slots, "
+            f"lanes {self.lane_widths}, {self.warm_compiles} XLA compiles "
+            f"in {self.warm_seconds:.1f}s (cached compiles are free)",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    def reset(self) -> None:
+        """Fresh empty carry + all slots free (startup and wedge
+        recovery).  Any payload bindings must have been failed/handed off
+        by the caller first."""
+        self._carry = self._reset_exec()
+        self._free = set(range(self.slots))
+        self._payload.clear()
+        self._mask[:] = False
+        self._tel.gauge("serve/slot_occupancy", 0)
+
+    # -- host bookkeeping --------------------------------------------------
+
+    def occupancy(self) -> int:
+        return self.slots - len(self._free)
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def inflight_payloads(self) -> List[Any]:
+        """Every bound payload in slot order (wedge containment: the
+        batcher fails these with 500s before reset())."""
+        return [self._payload[s] for s in sorted(self._payload)]
+
+    # -- device programs ---------------------------------------------------
+
+    def admit(self, items: List[Tuple[np.ndarray, Any]]) -> int:
+        """Seed up to ``free_count()`` (image_row, payload) pairs into
+        free slots; returns how many were admitted (surplus stays with
+        the caller).  Items are encoded in admission lanes — the
+        smallest warmed width that fits each burst — then one
+        ``init_slots`` gather scatters the lane into the free slots.
+        Both dispatches are async, so the host returns to the step loop
+        while the device encodes."""
+        import jax
+
+        admitted = 0
+        size = self.config.image_size
+        free = sorted(self._free)
+        while admitted < len(items) and free:
+            chunk = min(len(items) - admitted, len(free), self.width)
+            lane = next(w for w in self.lane_widths if w >= chunk)
+            images = np.zeros(
+                (lane, size, size, 3), self.engine._image_dtype
+            )
+            slot_src = np.zeros((self.slots,), np.int32)
+            admit_mask = np.zeros((self.slots,), np.bool_)
+            for j in range(chunk):
+                image, payload = items[admitted]
+                admitted += 1
+                s = free.pop(0)
+                images[j] = image
+                slot_src[s] = j
+                admit_mask[s] = True
+                self._free.discard(s)
+                self._payload[s] = payload
+                self._mask[s] = True
+            contexts = self._enc_execs[lane](
+                self.engine._variables, jax.device_put(images)
+            )
+            self._carry = self._seed_execs[lane](
+                self.engine._decoder_params,
+                self._carry,
+                contexts,
+                jax.device_put(slot_src),
+                jax.device_put(admit_mask),
+            )
+        self._tel.gauge("serve/slot_occupancy", self.occupancy())
+        return admitted
+
+    def step(self):
+        """One ``decode_step`` over the whole pool.  Returns the [S] done
+        flags STILL ON DEVICE — the caller owns the drain (and bounds it
+        with the wedge watchdog)."""
+        import jax
+
+        self._carry, done = self._step_exec(
+            self.engine._decoder_params,
+            self._carry,
+            jax.device_put(self._mask.copy()),
+        )
+        return done
+
+    def harvest(self, done: np.ndarray):
+        """Drain and free the slots flagged in ``done`` (host bool [S]).
+
+        Returns ``(payloads, words, lengths, scores, steps)`` with one
+        row per harvested slot, in slot order.  Whole-array transfers
+        sliced on the HOST — a device-side gather at a varying row set
+        would compile per distinct pattern (same rationale as
+        ``ServeEngine.drain_output``)."""
+        import jax
+
+        ids = [int(s) for s in np.nonzero(done)[0] if self._mask[s]]
+        out = self._harvest_exec(self._carry)
+        words = np.asarray(out.words)  # sync-ok: continuous detok boundary — harvested results drained once
+        lengths = np.asarray(out.lengths)  # sync-ok: continuous detok boundary
+        scores = np.asarray(out.log_scores)  # sync-ok: continuous detok boundary
+        steps = np.asarray(out.steps_run)  # sync-ok: continuous detok boundary
+        retire = np.zeros((self.slots,), np.bool_)
+        payloads = []
+        for s in ids:
+            retire[s] = True
+            payloads.append(self._payload.pop(s))
+            self._mask[s] = False
+            self._free.add(s)
+        self._carry = self._retire_exec(
+            self._carry, jax.device_put(retire)
+        )
+        self._tel.gauge("serve/slot_occupancy", self.occupancy())
+        return payloads, words[ids], lengths[ids], scores[ids], steps[ids]

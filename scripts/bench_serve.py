@@ -21,6 +21,19 @@ scripts/check_regression.py:
 
 * ``serve_closed_loop_throughput`` (req_per_s, higher is better)
 * ``serve_open_loop_p99_latency_ms`` (ms, lower is better)
+* ``serve_continuous_goodput`` (req_per_s, higher is better) — open
+  loop at ``--cont-rate`` (≈ the batch path's padded-bucket capacity)
+  against ``--serve_mode continuous`` (paged slot pool, step-level
+  admission); a batch-mode run at the SAME rate is measured first and
+  reported as ``batch_ref_goodput`` / ``batch_ref_p99_ms`` extras, so
+  the row demonstrates continuous beating batch on both captions/s and
+  p99 at high offered load
+* ``serve_admission_latency_ms`` (ms, lower is better) — p95 submit →
+  slot-seeded time in continuous mode (what the whole-batch gather +
+  hold-open window used to cost)
+
+Both modes run against one warmed engine; each asserts ZERO XLA compiles
+during its load phase (exit 1 on any steady-state recompile).
 
 Usage: python scripts/bench_serve.py [--concurrency 8] [--requests 25]
        [--rate 50] [--open-requests 200] [--buckets 1,4,16]
@@ -64,13 +77,23 @@ SENTENCES = [
 
 
 def _make_jpegs(n: int, size: int) -> list:
+    """Structurally DIVERSE images — each index gets its own rng, solid
+    region and channel, so the encoded contexts differ enough for
+    input-dependent seal steps (near-identical noise images collapse to
+    one caption length through the encoder, hiding the straggler regime
+    continuous batching exists for)."""
     import cv2
 
-    rng = np.random.default_rng(0)
     out = []
     for i in range(n):
+        rng = np.random.default_rng(100 + i)
         img = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
-        img[:, : size // 2, 0] = 200  # structure, so resize is non-trivial
+        c = i % 3
+        extent = size // 4 + (3 * i) % (3 * size // 4)
+        if i % 2 == 0:
+            img[:extent, :, c] = 30 * (i + 1) % 255
+        else:
+            img[:, :extent, c] = max(0, 250 - 25 * i)
         ok, buf = cv2.imencode(".jpg", img)
         assert ok
         out.append(bytes(buf))
@@ -122,6 +145,19 @@ def _boot(args, workdir):
     tel = telemetry.enable(capacity=1 << 18)
     runtime._install_compile_listener()
     state = create_train_state(jax.random.PRNGKey(0), config)
+    if args.eos_bias != 0.0:
+        # shape the synthetic model toward realistic caption-length
+        # variance: a mild EOS-logit bias makes different inputs seal at
+        # different steps (short captions + stragglers — the regime
+        # continuous batching exists for).  Raw random params run every
+        # beam to max_caption_length, hiding early retirement entirely.
+        eos = vocabulary.word2idx["."]
+        params = jax.tree_util.tree_map(lambda x: x, state.params)
+        b = params["decoder"]["decode"]["fc_2"]["bias"]
+        params["decoder"]["decode"]["fc_2"]["bias"] = b.at[eos].add(
+            args.eos_bias
+        )
+        state = state._replace(params=params)
     path = save_checkpoint(state, config)
     lineage.mark_last_good(config.save_dir, int(np.asarray(state.step)))
     log(f"fresh params saved to {path}")
@@ -235,12 +271,24 @@ def main() -> int:
                     help="closed loop: requests per worker")
     ap.add_argument("--rate", type=float, default=50.0,
                     help="open loop: Poisson arrival rate, req/s")
+    ap.add_argument("--cont-rate", type=float, default=8.5,
+                    help="batch-vs-continuous comparison: Poisson rate "
+                         "near the batch path's padded-bucket capacity")
     ap.add_argument("--open-requests", type=int, default=200,
                     help="open loop: total arrivals")
     ap.add_argument("--buckets", default="1,4,16")
     ap.add_argument("--max-batch", type=int, default=16)
     ap.add_argument("--max-wait-ms", type=float, default=5.0)
     ap.add_argument("--queue-depth", type=int, default=128)
+    ap.add_argument("--slot-pages", type=int, default=4,
+                    help="continuous mode: pages in the slot pool")
+    ap.add_argument("--page-width", type=int, default=4,
+                    help="continuous mode: slots per page")
+    ap.add_argument("--eos-bias", type=float, default=0.006,
+                    help="EOS-logit bias on the fresh params: sits on the "
+                         "seal-step cliff so the diverse bench images give "
+                         "mixed caption lengths — most seal in 2-3 steps, "
+                         "a few run to max_caption_length (0 disables)")
     ap.add_argument("--workdir", default=None)
     args = ap.parse_args()
 
@@ -302,8 +350,95 @@ def main() -> int:
             "p50_ms": opened["p50"], "p95_ms": opened["p95"],
             **common,
         }), flush=True)
+
+        # --- batch vs continuous at the SAME near-capacity rate ----------
+        # deep saturation is the batch path's best case (every bucket
+        # rides full, encode fully amortized); the regime continuous
+        # batching exists for is offered load near the batch path's
+        # padded-bucket capacity, where whole-batch windows hold every
+        # request while lanes admit exactly what arrived
+        ref = open_loop(port, jpegs, args.cont_rate, args.open_requests)
+        ref_goodput = ref["ok"] / ref["wall_s"] if ref["wall_s"] else 0.0
+        log(f"batch reference @ {args.cont_rate}/s: {ref['ok']} ok in "
+            f"{ref['wall_s']:.1f}s -> {ref_goodput:.1f} req/s goodput "
+            f"(p50 {ref['p50']}ms p99 {ref['p99']}ms)")
+
+        server.shutdown()
+        server = None
+        from sat_tpu.serve.server import CaptionServer
+
+        cont_config = engine.config.replace(
+            serve_mode="continuous",
+            serve_slot_pages=args.slot_pages,
+            serve_page_width=args.page_width,
+        )
+        server = CaptionServer(cont_config, engine, port=0).start()
+        port = server.port
+        log(f"continuous server up on port {port} (slot pool "
+            f"{args.slot_pages}x{args.page_width}, pool warm_compiles "
+            f"{server.pool.warm_compiles})")
+        _post(port, jpegs[0])  # warm pass (first-touch host costs)
+        cont_compiles0 = tel.counters().get("jax/compiles", 0)
+        steps_before = len(tel.durations_ns("serve/decode_steps"))
+
+        cont = open_loop(port, jpegs, args.cont_rate, args.open_requests)
+        cont_goodput = cont["ok"] / cont["wall_s"] if cont["wall_s"] else 0.0
+        log(f"continuous open loop @ {args.cont_rate}/s: {cont['ok']} ok, "
+            f"{cont['shed']} shed in {cont['wall_s']:.1f}s -> "
+            f"{cont_goodput:.1f} req/s goodput "
+            f"(p50 {cont['p50']}ms p99 {cont['p99']}ms; batch @ same rate: "
+            f"{ref_goodput:.1f} req/s, p99 {ref['p99']}ms)")
+
+        cont_recompiles = (
+            tel.counters().get("jax/compiles", 0) - cont_compiles0
+        )
+        log(f"continuous steady-state XLA compiles during load: "
+            f"{cont_recompiles}")
+        admit_ns = np.asarray(
+            tel.durations_ns("serve/admission_wait"), np.float64
+        )
+        admit_p95 = (
+            round(float(np.sort(admit_ns)[min(
+                admit_ns.size - 1, int(0.95 * admit_ns.size)
+            )]) / 1e6, 3)
+            if admit_ns.size else 0.0
+        )
+        steps = np.asarray(
+            tel.durations_ns("serve/decode_steps")[steps_before:], np.float64
+        )
+        cont_common = dict(common)
+        cont_common.update(
+            slot_pages=args.slot_pages,
+            page_width=args.page_width,
+            pool_warm_compiles=server.pool.warm_compiles,
+            steady_state_compiles=cont_recompiles,
+            decode_steps_p50=(
+                float(np.percentile(steps, 50)) if steps.size else None
+            ),
+        )
+        print(json.dumps({
+            "metric": "serve_continuous_goodput",
+            "value": round(cont_goodput, 2),
+            "unit": "req_per_s",
+            "offered_rate_per_s": args.cont_rate,
+            "completed": cont["ok"], "shed": cont["shed"],
+            "p50_ms": cont["p50"], "p95_ms": cont["p95"],
+            "p99_ms": cont["p99"],
+            "batch_ref_goodput": round(ref_goodput, 2),
+            "batch_ref_p50_ms": ref["p50"],
+            "batch_ref_p99_ms": ref["p99"],
+            **cont_common,
+        }), flush=True)
+        print(json.dumps({
+            "metric": "serve_admission_latency_ms",
+            "value": admit_p95,
+            "unit": "ms",
+            "percentile": "p95",
+            "admitted": int(admit_ns.size),
+            **cont_common,
+        }), flush=True)
         # shedding under overload is fine; recompiling under load is not
-        return 0 if recompiles == 0 else 1
+        return 0 if recompiles == 0 and cont_recompiles == 0 else 1
     finally:
         if server is not None:
             server.shutdown()

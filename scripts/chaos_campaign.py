@@ -545,6 +545,134 @@ def wedge_postmortem(ctx: Ctx):
             "bundle_files": len(os.listdir(bundle))}
 
 
+# The serve-plane wedge rehearsal runs in its own process (the campaign
+# parent never initializes jax): boot the continuous-batching serve
+# stack with the wedge fault armed, prove in-flight slots surface fast
+# 500s, the slot pool re-warms, and the next request serves clean.
+_SERVE_WEDGE_CHILD = r'''
+import json, os, sys, time, urllib.error, urllib.request
+
+import cv2
+import jax
+import numpy as np
+
+from sat_tpu import runtime, telemetry
+from sat_tpu.config import Config
+from sat_tpu.data.vocabulary import Vocabulary
+from sat_tpu.resilience import lineage
+from sat_tpu.serve.engine import ServeEngine, load_serving_state
+from sat_tpu.serve.server import CaptionServer
+from sat_tpu.train.checkpoint import save_checkpoint
+from sat_tpu.train.step import create_train_state
+
+workdir = sys.argv[1]
+vocab_file = os.path.join(workdir, "vocabulary.csv")
+vocabulary = Vocabulary(size=30)
+vocabulary.build(["a man riding a horse.", "a cat on a table."])
+vocabulary.save(vocab_file)
+config = Config(
+    phase="serve", image_size=32, dim_embedding=16, num_lstm_units=16,
+    dim_initialize_layer=16, dim_attend_layer=16, dim_decode_layer=32,
+    compute_dtype="float32", vocabulary_size=vocabulary.size,
+    vocabulary_file=vocab_file, beam_size=2,
+    save_dir=os.path.join(workdir, "models"),
+    summary_dir=os.path.join(workdir, "summary"),
+    serve_mode="continuous", serve_slot_pages=2, serve_page_width=2,
+    serve_wedge_timeout_ms=250.0, heartbeat_interval=0.0,
+)
+os.makedirs(config.save_dir, exist_ok=True)
+tel = telemetry.enable()
+runtime._install_compile_listener()
+state = create_train_state(jax.random.PRNGKey(0), config)
+save_checkpoint(state, config)
+lineage.mark_last_good(config.save_dir, int(np.asarray(state.step)))
+state, _ = load_serving_state(config)
+engine = ServeEngine(config, state, vocabulary, tel=tel)
+server = CaptionServer(config, engine, port=0).start()
+port = server.port
+
+img = np.random.default_rng(0).integers(0, 255, (32, 32, 3), dtype=np.uint8)
+ok, buf = cv2.imencode(".jpg", img)
+jpeg = bytes(buf)
+
+
+def post(timeout=60.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/caption", data=jpeg, method="POST",
+        headers={"Content-Type": "image/jpeg"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def get(route):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{route}", timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+result = {}
+status, payload = post(timeout=30.0)
+result["wedged_status"] = status
+result["wedged_error"] = payload.get("error", "")
+result["wedged_batches"] = tel.counters().get("serve/wedged_batches", 0)
+deadline = time.time() + 60.0
+health = {}
+while time.time() < deadline:
+    code, health = get("/healthz")
+    if code == 200 and health.get("status") == "ok":
+        break
+    time.sleep(0.05)
+result["health_status"] = health.get("status", "")
+result["rewarms"] = tel.counters().get("serve/rewarms", 0)
+status, payload = post()
+result["retry_status"] = status
+result["retry_captions"] = bool(payload.get("captions"))
+result["pool_busy_after"] = server.pool.occupancy()
+server.shutdown()
+print(json.dumps(result))
+'''
+
+
+@scenario
+def serve_wedge_continuous(ctx: Ctx):
+    """SAT_FI_WEDGE_SERVE_BATCH against --serve_mode continuous: the
+    wedged decode step fails its in-flight slots with fast 500s, the
+    paged slot pool re-warms (cached compiles), health recovers, and
+    the next request serves clean."""
+    workdir = os.path.join(ctx.root, "serve_wedge")
+    os.makedirs(workdir, exist_ok=True)
+    proc = subprocess.run(
+        [sys.executable, "-c", _SERVE_WEDGE_CHILD, workdir],
+        capture_output=True, text=True, cwd=REPO,
+        env=_child_env({"SAT_FI_WEDGE_SERVE_BATCH": "1"}),
+        timeout=_TIMEOUT,
+    )
+    check(proc.returncode == 0,
+          f"serve wedge child rc {proc.returncode}\n"
+          f"{proc.stdout}\n{proc.stderr}")
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    check(result["wedged_status"] == 500,
+          f"in-flight request got {result['wedged_status']}, wanted 500")
+    check("wedged" in result["wedged_error"],
+          f"500 body does not name the wedge: {result['wedged_error']!r}")
+    check(result["wedged_batches"] >= 1, "serve/wedged_batches never counted")
+    check(result["health_status"] == "ok",
+          f"health never recovered: {result['health_status']!r}")
+    check(result["rewarms"] >= 1, "slot pool never re-warmed")
+    check(result["retry_status"] == 200 and result["retry_captions"],
+          f"post-recovery request failed: {result['retry_status']}")
+    check(result["pool_busy_after"] == 0,
+          f"slots leaked after recovery: {result['pool_busy_after']} busy")
+    return {k: result[k] for k in
+            ("wedged_status", "rewarms", "retry_status", "pool_busy_after")}
+
+
 # -- orchestration ----------------------------------------------------------
 
 
