@@ -207,6 +207,16 @@ def build_config(argv: Optional[List[str]] = None):
              "beams early (docs/SERVING.md)",
     )
     p.add_argument(
+        "--encoder_quant", choices=("off", "bf16", "int8"), default=None,
+        help="serve phase: post-training quantization of the frozen CNN "
+             "encoder at param load, before AOT warmup (docs/SERVING.md "
+             "'Precision & parity').  'int8' = per-output-channel symmetric "
+             "int8 kernels + calibrated activation scales, convs run "
+             "int8xint8->int32 on the MXU with fused dequant; 'bf16' = "
+             "bfloat16 kernel storage; 'off' (default) is bitwise the "
+             "unquantized path",
+    )
+    p.add_argument(
         "--supervise", action="store_true",
         help="crash-only restart loop (docs/RESILIENCE.md): keep this "
              "process jax-free and run the real work in a child; a child "
@@ -305,6 +315,8 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(serve_max_wait_ms=args.max_wait_ms)
     if args.serve_mode is not None:
         config = config.replace(serve_mode=args.serve_mode)
+    if args.encoder_quant is not None:
+        config = config.replace(encoder_quant=args.encoder_quant)
     if args.watchdog is not None:
         config = config.replace(watchdog_interval=args.watchdog)
     overrides = {}

@@ -323,6 +323,21 @@ class Config:
     # of magnitude lower context-vector error vs an fp32 ground truth
     # (scripts/bench_pallas.py).
     use_pallas_attention: bool = True
+    # Post-training quantization of the FROZEN encoder on the serve path
+    # (sat_tpu/nn/quant.py; docs/SERVING.md "Precision & parity").  "off"
+    # (default) is bitwise the unquantized path.  "bf16" stores the conv
+    # kernels in bfloat16 (halving their HBM residency; compute already
+    # runs bf16 on the MXU).  "int8" converts conv kernels to per-output-
+    # channel symmetric int8 with fp32 scales at load time, calibrates
+    # per-layer activation ranges host-side over encoder_quant_calib_batches
+    # batches (one-time, before AOT warmup), and runs the convs as
+    # int8xint8->int32 MXU ops with fused dequant; the [B,N,D] context
+    # output stays fp32.  Serving-only: the train path always runs the
+    # fp32/bf16 flax encoder, and the caption-parity harness
+    # (tests/test_quant.py) bounds the divergence vs fp32.
+    encoder_quant: str = "off"
+    encoder_quant_calib_batches: int = 4
+    encoder_quant_calib_batch_size: int = 8
     # Feed uint8 RGB and run the final astype(float32)−ILSVRC-mean on
     # device (models.captioner.encode): bitwise-equal preprocessing
     # (the resize already happens on uint8 either way), 4× smaller
@@ -355,6 +370,7 @@ class Config:
             ("verify_shards", ("off", "sample", "open", "full")),
             ("anomaly_policy", ("off", "warn", "skip", "rollback")),
             ("diag_level", ("off", "basic", "full")),
+            ("encoder_quant", ("off", "bf16", "int8")),
         )
         for name, allowed in checks:
             if getattr(self, name) not in allowed:
@@ -450,6 +466,14 @@ class Config:
         if self.serve_slot_pages <= 0 or self.serve_page_width <= 0:
             raise ValueError(
                 "Config.serve_slot_pages and serve_page_width must be >= 1"
+            )
+        if (
+            self.encoder_quant_calib_batches <= 0
+            or self.encoder_quant_calib_batch_size <= 0
+        ):
+            raise ValueError(
+                "Config.encoder_quant_calib_batches and "
+                "encoder_quant_calib_batch_size must be >= 1"
             )
         for name in (
             "watchdog_interval",

@@ -79,6 +79,16 @@ def encode(
         from ..data.images import ILSVRC_2012_MEAN
 
         images = images.astype(jnp.float32) - jnp.asarray(ILSVRC_2012_MEAN)
+    if config.encoder_quant != "off" and "qcnn" in variables:
+        # serve-path quantized encoder (nn/quant.py): the engine swaps the
+        # fp32 cnn params for the 'qcnn' collection at load time, so this
+        # branch is structurally unreachable from training (train variables
+        # never carry qcnn) and config.encoder_quant="off" stays bitwise
+        # the flax path below
+        from ..nn import quant
+
+        contexts = quant.quantized_encode(variables, config, images)
+        return jax.lax.stop_gradient(contexts), {}
     encoder = make_encoder(config)
     cnn_vars: Dict[str, Any] = {"params": variables["params"]["cnn"]}
     if "batch_stats" in variables:

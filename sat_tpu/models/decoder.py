@@ -288,19 +288,33 @@ def attend_with_precomputed(
     contexts: jnp.ndarray,
     ctx_proj: jnp.ndarray,
     output: jnp.ndarray,
+    row_mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Inference-path attention using the hoisted ``ctx_proj``.
 
     Returns (context [B, D], alpha [B, N]).  With use_pallas_attention the
     2-layer combine runs as one fused Pallas kernel (add → matvec →
     softmax → weighted sum in a single VMEM residency).
+
+    row_mask: optional [B] bool — slot-pool geometry (the stepped decode
+    batches dead slots alongside live ones).  False rows get zero
+    scores/alpha/context so stale slot state can never emit a NaN; True
+    rows are bitwise identical to the unmasked call.  Masking is applied
+    identically on the Pallas and XLA paths so the two stay comparable.
     """
     p = params["attend"]
     dt = jnp.dtype(config.compute_dtype)
+    valid = None if row_mask is None else row_mask.reshape(-1, 1)   # [B, 1]
     if config.num_attend_layers == 1:
         logits = ctx_proj + _dense(p["fc_b"], output, dtype=dt)     # [B, N]
+        if valid is not None:
+            logits = jnp.where(valid, logits, 0.0)
         alpha = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        if valid is not None:
+            alpha = jnp.where(valid, alpha, 0.0)
         context = (contexts * alpha[..., None]).sum(axis=1)
+        if valid is not None:
+            context = jnp.where(valid, context, 0.0)
         return context, alpha
 
     t2 = _dense(p["fc_1b"], output, activation="tanh", dtype=dt)    # [B, da]
@@ -312,13 +326,20 @@ def attend_with_precomputed(
         if jax.default_backend() == "tpu" or pallas_attention.FORCE_INTERPRET:
             return pallas_attention.fused_attend(
                 ctx_proj, t2, p["fc_2"]["kernel"], contexts,
+                row_mask=row_mask,
                 compute_dtype=config.compute_dtype,
                 interpret=jax.default_backend() != "tpu",
             )
     temp = ctx_proj + t2[:, None, :]
     logits = _dense(p["fc_2"], temp, dtype=dt)[..., 0]              # [B, N]
+    if valid is not None:
+        logits = jnp.where(valid, logits, 0.0)
     alpha = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    if valid is not None:
+        alpha = jnp.where(valid, alpha, 0.0)
     context = (contexts * alpha[..., None]).sum(axis=1)
+    if valid is not None:
+        context = jnp.where(valid, context, 0.0)
     return context, alpha
 
 
@@ -362,6 +383,7 @@ def decoder_step(
     rng: Optional[jax.Array] = None,
     ctx_proj: Optional[jnp.ndarray] = None,
     with_activity: bool = False,
+    row_mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[DecoderState, jnp.ndarray, jnp.ndarray]:
     """One decoder step: attend → embed → LSTM → logits.
 
@@ -373,6 +395,11 @@ def decoder_step(
     ctx_proj: hoisted :func:`precompute_attend` output — inference only
     (training's per-step context dropout invalidates it, so it is ignored
     when train=True).
+
+    row_mask: optional [B] bool, forwarded to
+    :func:`attend_with_precomputed` on the hoisted inference path (the
+    stepped decode's dead-slot mask); ignored elsewhere — the monolithic
+    path never sets it, so its programs are untouched.
     """
     if train:
         k_att, k_in, k_out, k_state, k_dec = jax.random.split(rng, 5)
@@ -383,7 +410,8 @@ def decoder_step(
 
     if ctx_proj is not None and not train:
         context, alpha = attend_with_precomputed(
-            params, config, contexts, ctx_proj, state.output
+            params, config, contexts, ctx_proj, state.output,
+            row_mask=row_mask,
         )
     else:
         alpha = attend(

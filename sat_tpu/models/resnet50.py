@@ -136,3 +136,31 @@ class ResNet50(nn.Module):
         # 49 contexts at the reference's 224×224 input (model.py:103-108);
         # -1 keeps the module usable at other static image sizes.
         return x.reshape(b, -1, DIM_CTX).astype(jnp.float32)
+
+
+def quant_forward(conv, images):
+    """Topology walker for the quantized serve path (sat_tpu.nn.quant).
+
+    ``conv(name, x, strides=1, relu=False)`` is a BN-folded conv+bias at
+    the chosen precision — the frozen batch norms are folded into each
+    conv's kernel/bias at quantize time, so this walk is the __call__
+    graph above with every (conv, bn) pair collapsed to one op; residual
+    adds and relus run at the conv fn's output precision.
+    """
+    x = conv("conv1", images, strides=2, relu=True)
+    x = max_pool2d(x, pool_size=(3, 3), strides=(2, 2))
+    for prefix, _width, n_identity, stride in _STAGES:
+        st = f"{prefix}a"
+        shortcut = conv(f"res{st}_branch1", x, strides=stride)
+        y = conv(f"res{st}_branch2a", x, strides=stride, relu=True)
+        y = conv(f"res{st}_branch2b", y, relu=True)
+        y = conv(f"res{st}_branch2c", y)
+        x = nn.relu(shortcut + y)
+        for i in range(n_identity):
+            st = f"{prefix}{chr(ord('b') + i)}"
+            y = conv(f"res{st}_branch2a", x, relu=True)
+            y = conv(f"res{st}_branch2b", y, relu=True)
+            y = conv(f"res{st}_branch2c", y)
+            x = nn.relu(x + y)
+    b = x.shape[0]
+    return x.reshape(b, -1, DIM_CTX).astype(jnp.float32)

@@ -52,3 +52,21 @@ class VGG16(nn.Module):
         # 196 contexts at the reference's 224×224 input (model.py:54-59);
         # -1 keeps the module usable at other static image sizes.
         return x.reshape(b, -1, DIM_CTX).astype(jnp.float32)
+
+
+def quant_forward(conv, images):
+    """Topology walker for the quantized serve path (sat_tpu.nn.quant).
+
+    ``conv(name, x, strides=1, relu=False)`` supplies the precision:
+    fp32 (calibration observer), bf16, or int8-with-fused-dequant.  The
+    walk is the exact __call__ graph above — 13 'SAME' 3×3 convs in 5
+    blocks with max-pool after the first 4 — so the only divergence
+    between the flax path and the quantized path is the conv arithmetic.
+    """
+    x = images
+    for name, _features, pool_after in _VGG_LAYERS:
+        x = conv(name, x, relu=True)
+        if pool_after:
+            x = max_pool2d(x)
+    b = x.shape[0]
+    return x.reshape(b, -1, DIM_CTX).astype(jnp.float32)

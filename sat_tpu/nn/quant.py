@@ -1,0 +1,315 @@
+"""Post-training quantization of the frozen CNN encoder (serve path).
+
+``config.encoder_quant`` selects the serve-time precision of the frozen
+VGG16/ResNet50 conv stack (docs/SERVING.md, "Precision & parity"):
+
+* ``off``  — this module never runs; the path is bitwise the flax encoder.
+* ``bf16`` — conv kernels are stored in bfloat16 (halving their HBM
+  residency; the MXU compute already runs bf16 on the normal path).
+* ``int8`` — conv kernels become per-output-channel *symmetric* int8 with
+  fp32 scales (scale = absmax/127 per output channel), activations are
+  quantized per-tensor against ranges measured by a one-time host-side
+  calibration pass, and every conv runs as int8 x int8 -> int32 (MXU
+  native) with the dequant fused into the bias add.  The [B, N, D]
+  context output stays fp32, so the decoder sees the same interface.
+
+ResNet50's frozen batch norms are folded into the preceding conv's kernel
+and bias before quantization (standard PTQ: w' = w * gamma/sqrt(var+eps),
+b' = beta - mean * gamma/sqrt(var+eps)), so the quantized graph is pure
+conv+bias(+relu) for both backbones and the model files only have to
+export a topology walker (``vgg16.quant_forward`` / ``resnet50.quant_forward``).
+
+Quantization happens ONCE at param-load time (serve/engine.py), before
+any AOT warmup, so every warmed executable — bucket ladder and slot-pool
+encode lanes alike — compiles against the quantized weights and the
+zero-steady-state-recompile guarantee is untouched.  The caption-parity
+harness (tests/test_quant.py) bounds context-grid / per-step-logit /
+caption divergence vs the fp32 path.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_DN = ("NHWC", "HWIO", "NHWC")  # image, kernel, output layouts
+_EPS = 1e-6  # absmax floor: an all-zero tensor quantizes to scale=eps/127
+
+
+# ---------------------------------------------------------------------------
+# Weight-side primitives
+# ---------------------------------------------------------------------------
+
+
+def quantize_kernel(kernel: jnp.ndarray):
+    """[kh,kw,cin,cout] fp32 -> (int8 kernel, fp32 per-output-channel scales).
+
+    Symmetric: q = round(w / scale), scale = absmax/127 over each output
+    channel — zero-point-free, so the int32 accumulator needs no
+    correction term and maps 1:1 onto the MXU's s8xs8->s32 path.
+    """
+    k = jnp.asarray(kernel, jnp.float32)  # sync-ok: one-time load transfer
+    absmax = jnp.maximum(jnp.abs(k).max(axis=(0, 1, 2)), _EPS)  # [cout]
+    scale = absmax / 127.0
+    q = jnp.clip(jnp.round(k / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def fold_bn(kernel, bias, gamma, beta, mean, var, eps: float = 1e-3):
+    """Fold a frozen batch norm into the preceding conv.
+
+    y = gamma * (conv(x) + bias - mean)/sqrt(var+eps) + beta
+      = conv(x) * s + (bias - mean) * s + beta,   s = gamma/sqrt(var+eps)
+    """
+    s = jnp.asarray(gamma, jnp.float32) / jnp.sqrt(  # sync-ok: load-time fold
+        jnp.asarray(var, jnp.float32) + eps  # sync-ok: load-time fold
+    )
+    # broadcast over [kh,kw,cin,cout]
+    k = jnp.asarray(kernel, jnp.float32) * s  # sync-ok: load-time fold
+    b = jnp.zeros_like(s) if bias is None else jnp.asarray(bias, jnp.float32)  # sync-ok: load-time fold
+    b = (b - jnp.asarray(mean, jnp.float32)) * s + jnp.asarray(beta, jnp.float32)  # sync-ok: load-time fold
+    return k, b
+
+
+# ---------------------------------------------------------------------------
+# Param-tree flattening (flax module tree -> flat name -> leaves)
+# ---------------------------------------------------------------------------
+
+
+def _flatten_convs(tree: Dict[str, Any], out: Dict[str, Dict[str, Any]]):
+    """Collect {conv_module_name: {'kernel', 'bias'?}} from a cnn param tree.
+
+    A Conv wrapper is a module named e.g. ``conv1_1`` / ``res2a_branch2a``
+    holding an inner nn.Conv named ``conv``; leaf names are unique across
+    both backbones, so a flat namespace is safe.
+    """
+    for name, sub in tree.items():
+        if not isinstance(sub, dict):
+            continue
+        inner = sub.get("conv")
+        if isinstance(inner, dict) and "kernel" in inner:
+            out[name] = inner
+        else:
+            _flatten_convs(sub, out)
+
+
+def _flatten_bns(tree: Dict[str, Any], out: Dict[str, Dict[str, Any]]):
+    """Collect {bn_name: {'scale','bias'} or {'mean','var'}} leaves."""
+    for name, sub in tree.items():
+        if not isinstance(sub, dict):
+            continue
+        if ("scale" in sub and "bias" in sub) or ("mean" in sub and "var" in sub):
+            out.setdefault(name, {}).update(sub)
+        else:
+            _flatten_bns(sub, out)
+
+
+def _bn_name_for(conv_name: str) -> str:
+    """Reference scope naming: conv1 -> bn_conv1, resXy_brZ -> bnXy_brZ."""
+    if conv_name == "conv1":
+        return "bn_conv1"
+    return "bn" + conv_name[len("res"):]
+
+
+def folded_convs(variables: Dict[str, Any], config) -> Dict[str, Dict[str, Any]]:
+    """Flat {name: {'kernel' fp32, 'bias' fp32}} with frozen BN folded in."""
+    convs: Dict[str, Dict[str, Any]] = {}
+    _flatten_convs(variables["params"]["cnn"], convs)
+    bns: Dict[str, Dict[str, Any]] = {}
+    _flatten_bns(variables["params"]["cnn"], bns)
+    if "batch_stats" in variables:
+        _flatten_bns(variables["batch_stats"], bns)
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, leaves in convs.items():
+        kernel = jnp.asarray(leaves["kernel"], jnp.float32)  # sync-ok: one-time load transfer
+        bias = leaves.get("bias")
+        bn = bns.get(_bn_name_for(name)) if config.cnn == "resnet50" else None
+        if bn is not None:
+            kernel, bias = fold_bn(
+                kernel, bias, bn["scale"], bn["bias"], bn["mean"], bn["var"]
+            )
+        elif bias is None:
+            bias = jnp.zeros((kernel.shape[-1],), jnp.float32)
+        out[name] = {"kernel": kernel, "bias": jnp.asarray(bias, jnp.float32)}  # sync-ok: one-time load transfer
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Topology dispatch + conv-fn factories
+# ---------------------------------------------------------------------------
+
+
+def _walker(config):
+    if config.cnn == "vgg16":
+        from ..models import vgg16
+
+        return vgg16.quant_forward
+    from ..models import resnet50
+
+    return resnet50.quant_forward
+
+
+def _conv2d(x, kernel, strides: int, preferred=None):
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(strides, strides),
+        padding="SAME",
+        dimension_numbers=_DN,
+        preferred_element_type=preferred,
+    )
+
+
+def _fp32_conv_fn(folded, observer: Optional[Dict[str, float]] = None) -> Callable:
+    """fp32 conv+bias(+relu) over the folded graph; optionally records the
+    per-layer input absmax (the calibration observer)."""
+
+    def conv(name, x, strides=1, relu=False):
+        if observer is not None:
+            seen = float(jnp.abs(x).max())  # sync-ok: one-time host-side calibration at load, never a serve/train hot path
+            observer[name] = max(observer.get(name, 0.0), seen)
+        y = _conv2d(x.astype(jnp.float32), folded[name]["kernel"], strides)
+        y = y + folded[name]["bias"]
+        return jax.nn.relu(y) if relu else y
+
+    return conv
+
+
+def _bf16_conv_fn(qcnn) -> Callable:
+    def conv(name, x, strides=1, relu=False):
+        y = _conv2d(x.astype(jnp.bfloat16), qcnn[name]["kernel"], strides)
+        y = y + qcnn[name]["bias"].astype(jnp.bfloat16)
+        return jax.nn.relu(y) if relu else y
+
+    return conv
+
+
+def _int8_conv_fn(qcnn) -> Callable:
+    def conv(name, x, strides=1, relu=False):
+        spec = qcnn[name]
+        s_act = spec["act_scale"]  # fp32 scalar
+        xq = jnp.clip(
+            jnp.round(x.astype(jnp.float32) / s_act), -127, 127
+        ).astype(jnp.int8)
+        y = _conv2d(xq, spec["kernel"], strides, preferred=jnp.int32)
+        # fused dequant: one fp32 multiply-add per output element
+        y = y.astype(jnp.float32) * (s_act * spec["w_scale"]) + spec["bias"]
+        return jax.nn.relu(y) if relu else y
+
+    return conv
+
+
+# ---------------------------------------------------------------------------
+# Calibration
+# ---------------------------------------------------------------------------
+
+
+def calibration_batches(config, batches: Optional[Iterable] = None) -> List[np.ndarray]:
+    """Mean-subtracted fp32 image batches for activation-range calibration.
+
+    Prefers real rows from the preprocessed shard cache (the serve host
+    usually has one; the rows ARE the live path's post-resize uint8
+    intermediate); falls back to deterministic synthetic uint8 noise in
+    the same value range when no cache is present, so quantization always
+    succeeds at load time.
+    """
+    if batches is not None:
+        return [np.asarray(b, np.float32) for b in batches]  # sync-ok: one-time load-time calibration input staging
+    from ..data.images import ILSVRC_2012_MEAN
+
+    n = config.encoder_quant_calib_batches
+    b = config.encoder_quant_calib_batch_size
+    s = config.image_size
+    rows: Optional[np.ndarray] = None
+    try:
+        shard_files = sorted(
+            glob.glob(os.path.join(config.shard_cache_dir, "*.npy"))
+        )
+        if shard_files and config.shard_cache != "off":
+            arr = np.load(shard_files[0], mmap_mode="r")
+            if arr.ndim == 4 and arr.shape[1:] == (s, s, 3):
+                rows = np.asarray(arr[: n * b], np.uint8)  # sync-ok: host mmap read of shard rows at load time
+    except Exception:
+        rows = None  # unreadable/mismatched cache: synthetic fallback below
+    if rows is None or len(rows) == 0:
+        rng = np.random.default_rng(0)
+        rows = rng.integers(0, 256, size=(n * b, s, s, 3)).astype(np.uint8)
+    imgs = rows.astype(np.float32) - ILSVRC_2012_MEAN
+    return [imgs[i * b : (i + 1) * b] for i in range(max(1, len(imgs) // b))]
+
+
+def calibrate(folded, config, batches: Iterable[np.ndarray]) -> Dict[str, float]:
+    """Run the fp32 folded graph over calibration batches, recording each
+    conv's input absmax.  Eager host-driven execution: this is a one-time
+    load-time pass over a handful of small batches, not a hot path."""
+    observer: Dict[str, float] = {}
+    walker = _walker(config)
+    conv = _fp32_conv_fn(folded, observer)
+    for batch in batches:
+        walker(conv, jnp.asarray(batch, jnp.float32))  # sync-ok: calibration
+    return observer
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def quantize_encoder(
+    variables: Dict[str, Any],
+    config,
+    batches: Optional[Iterable] = None,
+) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """Build the ``qcnn`` collection for ``config.encoder_quant``.
+
+    Returns a flat {conv_name: spec} pytree of device arrays:
+      bf16: {'kernel' bf16, 'bias' fp32}
+      int8: {'kernel' int8, 'w_scale' fp32 [cout], 'bias' fp32 [cout],
+             'act_scale' fp32 scalar}
+    """
+    mode = config.encoder_quant
+    if mode == "off":
+        raise ValueError("quantize_encoder called with encoder_quant='off'")
+    folded = folded_convs(variables, config)
+    if mode == "bf16":
+        return {
+            name: {
+                "kernel": spec["kernel"].astype(jnp.bfloat16),
+                "bias": spec["bias"],
+            }
+            for name, spec in folded.items()
+        }
+    ranges = calibrate(folded, config, calibration_batches(config, batches))
+    qcnn: Dict[str, Dict[str, jnp.ndarray]] = {}
+    for name, spec in folded.items():
+        q, w_scale = quantize_kernel(spec["kernel"])
+        act_scale = max(ranges.get(name, 0.0), _EPS) / 127.0
+        qcnn[name] = {
+            "kernel": q,
+            "w_scale": w_scale,
+            "bias": spec["bias"],
+            "act_scale": jnp.float32(act_scale),
+        }
+    return qcnn
+
+
+def quantized_encode(
+    variables: Dict[str, Any], config, images: jnp.ndarray
+) -> jnp.ndarray:
+    """images [B,H,W,3] fp32 (mean-subtracted) -> contexts [B,N,D] fp32,
+    through the quantized conv graph in ``variables['qcnn']``.  Traceable:
+    this is what the serve path jits/AOT-compiles."""
+    qcnn = variables["qcnn"]
+    if config.encoder_quant == "bf16":
+        conv = _bf16_conv_fn(qcnn)
+    elif config.encoder_quant == "int8":
+        conv = _int8_conv_fn(qcnn)
+    else:
+        raise ValueError(f"encoder_quant={config.encoder_quant!r}")
+    return _walker(config)(conv, images)

@@ -574,11 +574,16 @@ def decode_step(
     V = config.vocabulary_size
     An = carry.search.live_alphas.shape[3]
     active = slot_mask & carry.alive                             # [S]
+    row_active = jnp.repeat(active, K)                           # [S*K]
 
+    # dead rows' stale carry state is garbage to the decoder: row_mask
+    # zeroes their attention inside the (Pallas or XLA) attend so nothing
+    # non-finite can arise there; their outputs are then discarded by the
+    # selects below exactly as before.  Live rows are bitwise unchanged.
     new_state, logits, alpha = decoder_step(
         params, config, carry.ctx, carry.state,
         carry.search.last_word.reshape(S * K),
-        train=False, ctx_proj=carry.ctx_proj,
+        train=False, ctx_proj=carry.ctx_proj, row_mask=row_active,
     )
     g_state, stepped = _expand_step(
         eos_id, K, V, An, valid_size, new_state, logits, alpha,
@@ -587,7 +592,6 @@ def decode_step(
 
     # freeze everything in non-active slots — including sealed ones, whose
     # results must hold bitwise until the host harvests them
-    row_active = jnp.repeat(active, K)                           # [S*K]
 
     def sel_rows(new, old):
         return jnp.where(

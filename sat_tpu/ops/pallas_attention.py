@@ -92,6 +92,40 @@ def _make_kernel(compute_dtype):
     return _kernel
 
 
+def _make_masked_kernel(compute_dtype):
+    """Row-masked variant for slot-pool geometry (stepped decode).
+
+    Dead pool rows carry whatever the retired slot last held — possibly
+    non-finite after many steps of garbage arithmetic — so the mask must
+    neutralize them INSIDE the kernel: scores are zeroed before the
+    softmax (no exp of garbage) and alpha/context are zeroed after, so a
+    dead row can never emit or propagate a NaN.  Live rows take the
+    ``where`` true-branch everywhere and stay bitwise identical to the
+    unmasked kernel.
+    """
+    dt = jnp.dtype(compute_dtype)
+
+    def _kernel(t1_ref, t2_ref, w2_ref, bias_ref, ctx_ref, mask_ref,
+                out_ctx_ref, out_alpha_ref):
+        # blocks: as the unmasked kernel, plus mask [Bt,1] fp32 (>0 ⇒ live)
+        valid = mask_ref[...] > 0.0                                # [Bt,1]
+        temp = t1_ref[...] + t2_ref[...]                           # [Bt,Np,da]
+        prod = temp.astype(dt).astype(jnp.float32) * w2_ref[0].astype(
+            dt
+        ).astype(jnp.float32)
+        logits = jnp.sum(prod, axis=-1).astype(dt).astype(jnp.float32)
+        logits = jnp.where(valid, logits, 0.0) + bias_ref[...]     # [Bt,Np]
+        m = jnp.max(logits, axis=1, keepdims=True)                 # [Bt,1]
+        e = jnp.exp(logits - m)
+        alpha = e / jnp.sum(e, axis=1, keepdims=True)              # [Bt,Np]
+        alpha = jnp.where(valid, alpha, 0.0)
+        out_alpha_ref[...] = alpha
+        ctxsum = jnp.sum(alpha[:, :, None] * ctx_ref[...], axis=1)  # [Bt,D]
+        out_ctx_ref[...] = jnp.where(valid, ctxsum, 0.0)
+
+    return _kernel
+
+
 @partial(
     jax.jit, static_argnames=("compute_dtype", "interpret", "block_b")
 )
@@ -100,6 +134,7 @@ def fused_attend(
     t2: jnp.ndarray,
     w2: jnp.ndarray,
     contexts: jnp.ndarray,
+    row_mask: "jnp.ndarray | None" = None,
     compute_dtype: str = "float32",
     interpret: bool = False,
     block_b: int = DEFAULT_BLOCK_B,
@@ -110,6 +145,12 @@ def fused_attend(
     t2: [B, da]    fp32 — tanh(fc_1b(output)) for the current step.
     w2: [da, 1]    fp32 — second-layer projection.
     contexts: [B, N, D] fp32.
+    row_mask: optional [B] bool — slot-pool geometry (stepped decode):
+        False rows are dead slots whose inputs may be stale garbage; the
+        masked kernel zeroes their scores/alpha/context so nothing
+        non-finite propagates, while True rows stay bitwise identical to
+        the unmasked call.  ``None`` keeps the original kernel program
+        (the monolithic serve path) byte-for-byte.
     compute_dtype: the scoring multiply dtype (the model's MXU dtype).
     """
     B, N, da = t1.shape
@@ -132,6 +173,34 @@ def fused_attend(
     bias = jnp.where(
         (jnp.arange(Np) < N)[None, :], 0.0, _NEG_INF
     ).astype(jnp.float32)                                          # [1, Np]
+
+    if row_mask is not None:
+        # batch-pad rows are dead by construction (pad with 0 = masked)
+        mask_col = jnp.pad(
+            row_mask.astype(jnp.float32), ((0, b_pad),)
+        ).reshape(Bp, 1)
+        out_ctx, out_alpha = pl.pallas_call(
+            _make_masked_kernel(compute_dtype),
+            grid=(Bp // bt,),
+            in_specs=[
+                pl.BlockSpec((bt, Np, da), lambda b: (b, 0, 0)),
+                pl.BlockSpec((bt, 1, da), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, da), lambda b: (0, 0)),
+                pl.BlockSpec((1, Np), lambda b: (0, 0)),
+                pl.BlockSpec((bt, Np, D), lambda b: (b, 0, 0)),
+                pl.BlockSpec((bt, 1), lambda b: (b, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((bt, D), lambda b: (b, 0)),
+                pl.BlockSpec((bt, Np), lambda b: (b, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((Bp, D), jnp.float32),
+                jax.ShapeDtypeStruct((Bp, Np), jnp.float32),
+            ],
+            interpret=interpret,
+        )(t1, t2, w2_row, bias, contexts_p, mask_col)
+        return out_ctx[:B], out_alpha[:B, :N]
 
     out_ctx, out_alpha = pl.pallas_call(
         _make_kernel(compute_dtype),
@@ -161,6 +230,7 @@ def fused_attend_reference(
     t2: jnp.ndarray,
     w2: jnp.ndarray,
     contexts: jnp.ndarray,
+    row_mask: "jnp.ndarray | None" = None,
     compute_dtype: str = "float32",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Plain-XLA twin of :func:`fused_attend` (correctness oracle)."""
@@ -169,6 +239,13 @@ def fused_attend_reference(
     logits = (
         temp.astype(dt) @ w2.astype(dt)
     ).astype(jnp.float32)[..., 0]
+    if row_mask is not None:
+        valid = row_mask.reshape(-1, 1)
+        logits = jnp.where(valid, logits, 0.0)
     alpha = jax.nn.softmax(logits, axis=-1)
+    if row_mask is not None:
+        alpha = jnp.where(valid, alpha, 0.0)
     ctx = jnp.einsum("bn,bnd->bd", alpha, contexts.astype(jnp.float32))
+    if row_mask is not None:
+        ctx = jnp.where(valid, ctx, 0.0)
     return ctx, alpha

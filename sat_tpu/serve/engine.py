@@ -129,6 +129,37 @@ class ServeEngine:
         if state.batch_stats:
             self._variables["batch_stats"] = state.batch_stats
         self._decoder_params = state.params["decoder"]
+        self.encoder_quant = config.encoder_quant
+        self.quantize_seconds = 0.0
+        if config.encoder_quant != "off":
+            # Quantize ONCE at load, before any AOT warmup, so the bucket
+            # ladder and the slot-pool encode lanes all compile against
+            # the quantized weights and the zero-steady-state-recompile
+            # guarantee covers the quantized path unchanged.  The serve
+            # variables then carry ONLY the quantized encoder: the fp32
+            # cnn params (and the BN stats, folded into the conv biases)
+            # leave the tree so warmed executables never hold both
+            # copies of the encoder in HBM.
+            from ..nn import quant
+
+            t0 = time.perf_counter()
+            qcnn = quant.quantize_encoder(self._variables, config)
+            self._variables = {
+                "params": {"decoder": state.params["decoder"]},
+                "qcnn": qcnn,
+            }
+            self.quantize_seconds = time.perf_counter() - t0
+            self._tel.gauge(
+                "serve/encoder_quantize_seconds",
+                round(self.quantize_seconds, 3),
+            )
+            print(
+                f"sat_tpu: serve encoder quantized "
+                f"({config.encoder_quant}, {config.cnn}) in "
+                f"{self.quantize_seconds:.2f}s",
+                file=sys.stderr,
+                flush=True,
+            )
         self.buckets = _effective_buckets(
             config.serve_buckets, config.serve_max_batch
         )
@@ -235,7 +266,20 @@ class ServeEngine:
         import jax
 
         enc_exec, beam_exec = self._compiled[images.shape[0]]
+        t0 = time.perf_counter_ns()
         contexts = enc_exec(self._variables, jax.device_put(images))
+        if self._tel.enabled:
+            # encode-lane timing (the serve/encode_ms introspection): only
+            # with telemetry on do we wait out the encode before chaining
+            # the beam dispatch — the device queue keeps its ordering and
+            # the beam dispatch happens immediately after either way
+            jax.block_until_ready(contexts)  # sync-ok: opt-in telemetry encode timing, gated on tel.enabled
+            self._tel.record("serve/encode", t0, time.perf_counter_ns() - t0)
+            self._tel.record(
+                f"serve/encode_lane{images.shape[0]}",
+                t0,
+                time.perf_counter_ns() - t0,
+            )
         return beam_exec(self._decoder_params, contexts)
 
     def drain_output(self, out, n: int) -> Tuple[np.ndarray, ...]:

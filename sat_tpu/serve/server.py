@@ -522,6 +522,24 @@ class CaptionServer:
         steps = _percentiles_raw(self._tel, "serve/decode_steps")
         if steps:
             out["decode_steps"] = steps
+        # encoder introspection: the active quant mode plus per-lane
+        # encode timing (batch mode records per-bucket lanes, continuous
+        # mode per admission-lane width; both feed the aggregate span)
+        engine_block: Dict[str, Any] = {
+            "encoder_quant": self.engine.encoder_quant,
+            "quantize_seconds": round(self.engine.quantize_seconds, 3),
+        }
+        enc = _percentiles_ms(self._tel, "serve/encode")
+        if enc:
+            engine_block["encode_ms"] = enc
+        lanes = {}
+        for lane in self._encode_lanes():
+            p = _percentiles_ms(self._tel, f"serve/encode_lane{lane}")
+            if p:
+                lanes[str(lane)] = p
+        if lanes:
+            engine_block["encode_lanes_ms"] = lanes
+        out["engine"] = engine_block
         if self.pool is not None:
             out["slot_pool"] = {
                 "slots": self.pool.slots,
@@ -530,6 +548,14 @@ class CaptionServer:
                 "busy": self.pool.occupancy(),
             }
         return out
+
+    def _encode_lanes(self):
+        """Every encode-lane width this server can have timed: the bucket
+        ladder (batch mode) plus the pool's admission lanes (continuous)."""
+        lanes = set(self.engine.buckets)
+        if self.pool is not None:
+            lanes.update(self.pool.lane_widths)
+        return sorted(lanes)
 
     # -- observability endpoints -------------------------------------------
 
@@ -541,6 +567,13 @@ class CaptionServer:
         if steps:
             self._tel.gauge("serve/decode_steps_p50", steps["p50"])
             self._tel.gauge("serve/decode_steps_p95", steps["p95"])
+        enc = _percentiles_ms(self._tel, "serve/encode")
+        if enc:
+            # scrape-time refresh, same discipline as decode_steps: the
+            # serve/encode_ms gauge is the p50 device-encode time (p95
+            # rides alongside for burn-rate style alerting)
+            self._tel.gauge("serve/encode_ms", enc["p50"])
+            self._tel.gauge("serve/encode_ms_p95", enc["p95"])
         extra = self.heartbeat.payload() if self.heartbeat else None
         return promtext.render(self._tel, extra=extra)
 

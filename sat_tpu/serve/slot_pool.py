@@ -247,9 +247,19 @@ class PagedSlotPool:
                 self._free.discard(s)
                 self._payload[s] = payload
                 self._mask[s] = True
+            t0 = time.perf_counter_ns()
             contexts = self._enc_execs[lane](
                 self.engine._variables, jax.device_put(images)
             )
+            if self._tel.enabled:
+                # per-lane encode timing (serve/encode_ms introspection):
+                # the seed exec consumes the contexts immediately, so with
+                # telemetry on we wait the encode out here; with telemetry
+                # off the admission path stays fully async
+                jax.block_until_ready(contexts)  # sync-ok: opt-in telemetry encode timing, gated on tel.enabled
+                dur = time.perf_counter_ns() - t0
+                self._tel.record("serve/encode", t0, dur)
+                self._tel.record(f"serve/encode_lane{lane}", t0, dur)
             self._carry = self._seed_execs[lane](
                 self.engine._decoder_params,
                 self._carry,

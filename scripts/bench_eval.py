@@ -61,6 +61,14 @@ def main() -> int:
         help="the checkpoint run's config.vocabulary_size (logit width) "
         "when it differs from the default",
     )
+    ap.add_argument(
+        "--encoder-quant",
+        choices=("off", "bf16", "int8"),
+        default="off",
+        help="A/B the PTQ encoder (sat_tpu/nn/quant.py): measures the "
+        "fp32 arm first, then the quantized arm over the SAME weights, "
+        "emitting a second eval_images_per_sec_<mode> row",
+    )
     args = ap.parse_args()
     if args.params and not args.vocab:
         ap.error("--params requires --vocab (eos id + valid_size must come "
@@ -144,21 +152,67 @@ def main() -> int:
     print(f"compile+first: {compile_s:.1f}s", file=sys.stderr, flush=True)
 
     images_per_sec = 1e3 * B / windows_ms[0]
+    common = {
+        "unit": f"images/sec @ beam={args.beam}",
+        "batch_size": B,
+        "early_exit": not args.no_early_exit,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        **_bench_stamp(),
+    }
     print(
         json.dumps(
             {
                 "metric": "eval_images_per_sec",
                 "value": round(images_per_sec, 2),
-                "unit": f"images/sec @ beam={args.beam}",
-                "batch_size": B,
                 "batch_ms": round(windows_ms[0], 1),
-                "early_exit": not args.no_early_exit,
-                "device_kind": getattr(dev, "device_kind", dev.platform),
-                **_bench_stamp(),
+                "encoder_quant": "off",
+                **common,
             }
         ),
         flush=True,
     )
+
+    if args.encoder_quant != "off":
+        # quantized arm: same weights through the PTQ pass, same decode —
+        # the row pair is the encode-path A/B the PERF table quotes
+        import time as _time
+
+        from sat_tpu.nn import quant
+
+        qconfig = config.replace(encoder_quant=args.encoder_quant)
+        t0 = _time.perf_counter()
+        qcnn = quant.quantize_encoder(variables, qconfig)
+        quantize_s = _time.perf_counter() - t0
+        qvars = {
+            "params": {"decoder": variables["params"]["decoder"]},
+            "qcnn": qcnn,
+        }
+        qdecode = make_chained_decode(
+            qconfig, eos=eos, beam_size=args.beam, valid_size=valid_size,
+            early_exit=not args.no_early_exit,
+        )
+        q_compile_s, q_windows_ms, _ = time_decode_windows(
+            qdecode, qvars, images, args.iters, windows=1
+        )
+        print(
+            f"quant arm ({args.encoder_quant}) compile+first: "
+            f"{q_compile_s:.1f}s (quantize {quantize_s:.2f}s)",
+            file=sys.stderr, flush=True,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": f"eval_images_per_sec_{args.encoder_quant}",
+                    "value": round(1e3 * B / q_windows_ms[0], 2),
+                    "batch_ms": round(q_windows_ms[0], 1),
+                    "encoder_quant": args.encoder_quant,
+                    "quantize_seconds": round(quantize_s, 3),
+                    "fp32_images_per_sec": round(images_per_sec, 2),
+                    **common,
+                }
+            ),
+            flush=True,
+        )
     return 0
 
 

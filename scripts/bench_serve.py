@@ -284,6 +284,12 @@ def main() -> int:
                     help="continuous mode: pages in the slot pool")
     ap.add_argument("--page-width", type=int, default=4,
                     help="continuous mode: slots per page")
+    ap.add_argument("--quant-ab", choices=("none", "bf16", "int8"),
+                    default="none",
+                    help="A/B the PTQ encoder (sat_tpu/nn/quant.py): after "
+                         "the fp32 loops, reload the SAME checkpoint with "
+                         "--encoder_quant and re-run the closed loop, "
+                         "emitting serve_encode_ms / *_<mode> row pairs")
     ap.add_argument("--eos-bias", type=float, default=0.006,
                     help="EOS-logit bias on the fresh params: sits on the "
                          "seal-step cliff so the diverse bench images give "
@@ -305,6 +311,7 @@ def main() -> int:
         # one warm pass so steady-state numbers exclude first-touch costs
         _post(port, jpegs[0])
         compiles0 = tel.counters().get("jax/compiles", 0)
+        enc_mark = len(tel.durations_ns("serve/encode"))
 
         closed = closed_loop(port, jpegs, args.concurrency, args.requests)
         log(f"closed loop: {closed['ok']} ok in {closed['wall_s']:.1f}s -> "
@@ -350,6 +357,32 @@ def main() -> int:
             "p50_ms": opened["p50"], "p95_ms": opened["p95"],
             **common,
         }), flush=True)
+
+        def _enc_ms(start):
+            """Encode-lane percentiles from the serve/encode spans the
+            engine records (telemetry is on for the whole bench)."""
+            ns = np.asarray(tel.durations_ns("serve/encode")[start:],
+                            np.float64)
+            if not ns.size:
+                return None
+            s = np.sort(ns) / 1e6
+            def pct(p):
+                return round(float(s[min(s.size - 1,
+                                         int(p / 100.0 * s.size))]), 3)
+            return {"count": int(s.size), "p50": pct(50), "p95": pct(95)}
+
+        enc = _enc_ms(enc_mark)
+        if enc:
+            print(json.dumps({
+                "metric": "serve_encode_ms",
+                "value": enc["p50"],
+                "unit": "ms",
+                "percentile": "p50",
+                "p95_ms": enc["p95"],
+                "encodes": enc["count"],
+                "encoder_quant": "off",
+                **common,
+            }), flush=True)
 
         # --- batch vs continuous at the SAME near-capacity rate ----------
         # deep saturation is the batch path's best case (every bucket
@@ -437,8 +470,69 @@ def main() -> int:
             "admitted": int(admit_ns.size),
             **cont_common,
         }), flush=True)
+
+        # --- quantized-encoder A/B over the SAME checkpoint --------------
+        q_recompiles = 0
+        if args.quant_ab != "none":
+            server.shutdown()
+            server = None
+            from sat_tpu.serve.engine import ServeEngine, load_serving_state
+
+            qconfig = engine.config.replace(encoder_quant=args.quant_ab)
+            qstate, _ = load_serving_state(qconfig)
+            qengine = ServeEngine(
+                qconfig, qstate, engine.vocabulary, tel=tel
+            )
+            qengine.warmup()
+            server = CaptionServer(qconfig, qengine, port=0).start()
+            log(f"quant arm ({args.quant_ab}) up on port {server.port} "
+                f"(quantize {qengine.quantize_seconds:.2f}s, "
+                f"warm_compiles {qengine.warm_compiles})")
+            _post(server.port, jpegs[0])  # warm pass
+            q_compiles0 = tel.counters().get("jax/compiles", 0)
+            q_enc_mark = len(tel.durations_ns("serve/encode"))
+            qclosed = closed_loop(
+                server.port, jpegs, args.concurrency, args.requests
+            )
+            q_recompiles = (
+                tel.counters().get("jax/compiles", 0) - q_compiles0
+            )
+            log(f"quant closed loop: {qclosed['ok']} ok -> "
+                f"{qclosed['throughput']:.1f} req/s "
+                f"(p99 {qclosed['p99']}ms); steady-state compiles "
+                f"{q_recompiles}")
+            q_enc = _enc_ms(q_enc_mark)
+            q_common = dict(common)
+            q_common.update(
+                encoder_quant=args.quant_ab,
+                quantize_seconds=round(qengine.quantize_seconds, 3),
+                steady_state_compiles=q_recompiles,
+            )
+            if q_enc:
+                print(json.dumps({
+                    "metric": f"serve_encode_ms_{args.quant_ab}",
+                    "value": q_enc["p50"],
+                    "unit": "ms",
+                    "percentile": "p50",
+                    "p95_ms": q_enc["p95"],
+                    "encodes": q_enc["count"],
+                    "fp32_encode_p50_ms": enc["p50"] if enc else None,
+                    **q_common,
+                }), flush=True)
+            print(json.dumps({
+                "metric": f"serve_closed_loop_throughput_{args.quant_ab}",
+                "value": round(qclosed["throughput"], 2),
+                "unit": "req_per_s",
+                "p50_ms": qclosed["p50"], "p95_ms": qclosed["p95"],
+                "p99_ms": qclosed["p99"],
+                "fp32_throughput": round(closed["throughput"], 2),
+                **q_common,
+            }), flush=True)
+
         # shedding under overload is fine; recompiling under load is not
-        return 0 if recompiles == 0 and cont_recompiles == 0 else 1
+        return 0 if (
+            recompiles == 0 and cont_recompiles == 0 and q_recompiles == 0
+        ) else 1
     finally:
         if server is not None:
             server.shutdown()

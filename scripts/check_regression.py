@@ -86,6 +86,14 @@ DEFAULT_MARGINS = {
     "eval_images_per_sec": 5.0,
     "Bleu_4": 1.0,             # quality: a point of BLEU is never noise
     "CIDEr": 1.0,
+    "serve_encode_ms": 10.0,   # encode-lane timing: shared-host jitter
+    "serve_encode_ms_int8": 10.0,
+    "serve_encode_ms_bf16": 10.0,
+    # quantization parity deltas are bounded-zero: the fixture harness
+    # already holds them under their gate, so any measured GROWTH is a
+    # quantizer regression (wrong scale axis, dropped dequant), not noise
+    "quant_ctx_rel_err": 1.0,
+    "quant_logit_drift": 1.0,
 }
 FALLBACK_MARGIN = 5.0
 
@@ -102,6 +110,9 @@ _LOWER_BETTER_EXACT = {
     "temp_bytes",
     "output_bytes",
     "argument_bytes",
+    "serve_encode_ms",
+    "quant_ctx_rel_err",
+    "quant_logit_drift",
 }
 # explicitly HIGHER-better (checked first — "per_sec" would otherwise
 # trip the "_s" suffix heuristic below)
@@ -117,9 +128,17 @@ _HIGHER_BETTER_EXACT = {
 }
 _LOWER_BETTER_TOKENS = ("overhead", "seconds", "bytes", "latency")
 _LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_us", "_mb", "_time")
+# quant-arm rows suffix the base metric with their mode
+# (serve_encode_ms_int8, serve_closed_loop_throughput_bf16, ...) so the
+# A/B pair gates independently; the variant inherits the base direction
+_VARIANT_TAGS = ("_int8", "_bf16")
 
 
 def _lower_better(metric: str) -> bool:
+    for tag in _VARIANT_TAGS:
+        if metric.endswith(tag):
+            metric = metric[: -len(tag)]
+            break
     if metric in _HIGHER_BETTER_EXACT:
         return False
     if metric in _LOWER_BETTER_EXACT:

@@ -25,7 +25,7 @@ GAVE_UP=""
 # RETRY_STAGES / RETRY_STAGE_CMD / RETRY_PROBE_CMD exist so the
 # give-up/artifact bookkeeping is testable without a device
 # (tests/test_bench.py); production runs never set them.
-ORDER=${RETRY_STAGES:-"bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 bench_ce_bf16 bench_eval_ab pallas profile bench_early_exit"}
+ORDER=${RETRY_STAGES:-"bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 bench_ce_bf16 bench_eval_ab bench_quant pallas pallas_serve profile bench_early_exit"}
 
 stage_cmd() {
   if [ -n "${RETRY_STAGE_CMD:-}" ]; then echo "$RETRY_STAGE_CMD"; return; fi
@@ -38,9 +38,18 @@ stage_cmd() {
     bench_ce_bf16)        echo "env BENCH_CE_DTYPE=bfloat16 BENCH_BATCH=128 BENCH_EVAL=0 BENCH_SWEEP=0 BENCH_WATCHDOG_S=420 timeout 440 python bench.py" ;;
     # outer timeout > sum of internal budgets: 6 arms (3 repeats x 2) x 420
     bench_eval_ab)        echo "timeout 2600 python scripts/bench_eval_ab.py --budget-s 420" ;;
+    # int8 encoder A/B on both decode paths: eval throughput then the
+    # serve closed loop (which boots a second engine — hence ~2x the
+    # bench_serve budget); both write JSONL rows to the one artifact
+    bench_quant)          echo "timeout 2000 bash -c 'python scripts/bench_eval.py --batch 32 --encoder-quant int8 && python scripts/bench_serve.py --quant-ab int8'" ;;
     # batch sweep (4 sizes x up-to-4 loop compiles each) needs more than
     # the single-B budget
     pallas)               echo "timeout 1800 python scripts/bench_pallas.py" ;;
+    # fused attention on slot-pool geometries (masked rows, odd batches)
+    # compiled on the real chip — the CPU container can only
+    # interpret-mode these kernels, so parity there proves nothing about
+    # the Mosaic lowering
+    pallas_serve)         echo "timeout 600 python -m pytest tests/test_continuous.py tests/test_pallas.py -q -k pallas" ;;
     profile)              echo "timeout 900 bash scripts/profile_trace.sh $OUT" ;;
     # outer timeout > sum of the script's internal budgets (300+700+2*400)
     bench_early_exit)     echo "timeout 1900 bash scripts/bench_early_exit.sh $OUT" ;;
@@ -52,6 +61,7 @@ stage_cmd() {
 artifact() {
   case "$1" in
     pallas)  echo "$OUT/pallas.txt" ;;
+    pallas_serve) echo "$OUT/pallas_serve.txt" ;;
     profile) echo "$OUT/profile_done.txt" ;;
     *)       echo "$OUT/$1.json" ;;
   esac

@@ -81,10 +81,35 @@ if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_serve.json" ]; then
   FAILED="$FAILED bench_serve"
 fi
 
+echo "=== stage 1f: quantized-encoder A/B (int8 eval decode + serve closed loop) ==="
+timeout 600 python scripts/bench_eval.py --batch 32 --encoder-quant int8 \
+  2>"$OUT/bench_quant_eval.log" | tee "$OUT/bench_quant_eval.json"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_quant_eval.json" ]; then
+  echo "STAGE FAILED: bench_quant_eval (rc=$rc)"; FAILED="$FAILED bench_quant_eval"
+fi
+# second engine boot on top of the base run, hence ~2x the stage-1e budget
+timeout 1300 python scripts/bench_serve.py --quant-ab int8 \
+  2>"$OUT/bench_quant_serve.log" | tee "$OUT/bench_quant_serve.json"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_quant_serve.json" ]; then
+  echo "STAGE FAILED: bench_quant_serve (rc=$rc) — see $OUT/bench_quant_serve.log"
+  FAILED="$FAILED bench_quant_serve"
+fi
+
 echo "=== stage 2: pallas attention measurement ==="
 timeout 1800 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
 rc=${PIPESTATUS[0]}
 [ "$rc" -ne 0 ] && { echo "STAGE FAILED: pallas (rc=$rc)"; FAILED="$FAILED pallas"; }
+
+echo "=== stage 2a: fused serve-path attention parity on the chip ==="
+# slot-pool geometries (masked rows, odd batches) through the compiled
+# Mosaic kernel — the CPU container only interpret-modes these, so this
+# is the one place the masked pallas_call's lowering is actually tested
+timeout 600 python -m pytest tests/test_continuous.py tests/test_pallas.py \
+  -q -k pallas 2>&1 | tee "$OUT/pallas_serve.txt"
+rc=${PIPESTATUS[0]}
+[ "$rc" -ne 0 ] && { echo "STAGE FAILED: pallas_serve (rc=$rc)"; FAILED="$FAILED pallas_serve"; }
 
 echo "=== stage 2b: jax.profiler trace of the train hot loop ==="
 # one real trace backing the step-time/PrefetchLoader claims (r1 ask #8);

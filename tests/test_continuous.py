@@ -205,6 +205,81 @@ def test_stepped_per_slot_steps_reflect_early_exit():
     assert max(steps) == int(np.asarray(mono.steps_run))
 
 
+@pytest.mark.parametrize(
+    "pages,width,every", [(2, 2, 1), (1, 2, 3), (1, 1, 1)]
+)
+def test_stepped_pallas_vs_xla_slot_pool_parity(monkeypatch, pages, width, every):
+    """Fused-kernel decode at slot-pool geometry vs the XLA combine.
+
+    The pool batches dead slots alongside live ones (inactive-slot masks,
+    staggered admission, mid-pool retirement with slot reuse) — exactly
+    the geometry the row-masked kernel exists for.  The interpret-mode
+    kernel must produce the SAME captions as the XLA attend across every
+    geometry, and scores must agree to kernel-numerics tolerance."""
+    from sat_tpu.ops import pallas_attention
+
+    cfg, params, contexts = _ops_setup(
+        B=5, use_pallas_attention=True, num_attend_layers=2
+    )
+    xla = _stepped_decode_all(
+        cfg.replace(use_pallas_attention=False), params, contexts,
+        pages=pages, width=width, admit_every=every,
+    )
+    monkeypatch.setattr(pallas_attention, "FORCE_INTERPRET", True)
+    fused = _stepped_decode_all(
+        cfg, params, contexts, pages=pages, width=width, admit_every=every,
+    )
+    for i, (want, got) in enumerate(zip(xla, fused)):
+        assert np.array_equal(want.words, got.words), (pages, width, i)
+        np.testing.assert_allclose(
+            got.log_scores, want.log_scores, rtol=1e-4, atol=1e-5,
+            err_msg=str((pages, width, i)),
+        )
+
+
+def test_stepped_pallas_matches_monolithic_pallas(monkeypatch):
+    """With the kernel forced on BOTH paths, the stepped slot-pool decode
+    still matches the monolithic search caption-for-caption — the row
+    mask changes nothing for live rows."""
+    from sat_tpu.ops import pallas_attention
+
+    cfg, params, contexts = _ops_setup(
+        B=4, seed=3, use_pallas_attention=True, num_attend_layers=2
+    )
+    monkeypatch.setattr(pallas_attention, "FORCE_INTERPRET", True)
+    mono = bs.beam_search(params, cfg, contexts, EOS)
+    stepped = _stepped_decode_all(cfg, params, contexts, pages=2, width=2)
+    for i, got in enumerate(stepped):
+        assert np.array_equal(np.asarray(mono.words)[i], got.words), i
+        np.testing.assert_allclose(
+            got.log_scores, np.asarray(mono.log_scores)[i],
+            rtol=1e-5, atol=1e-6, err_msg=str(i),
+        )
+
+
+@pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="compiled (non-interpret) Pallas kernel needs a real TPU",
+)
+def test_stepped_pallas_vs_xla_on_tpu():
+    """Same parity assertion with the Mosaic-compiled kernel on a real
+    chip (the serve-path configuration: --serve_mode continuous runs this
+    kernel every decode step)."""
+    cfg, params, contexts = _ops_setup(
+        B=5, use_pallas_attention=True, num_attend_layers=2
+    )
+    xla = _stepped_decode_all(
+        cfg.replace(use_pallas_attention=False), params, contexts,
+        pages=2, width=2,
+    )
+    fused = _stepped_decode_all(cfg, params, contexts, pages=2, width=2)
+    for i, (want, got) in enumerate(zip(xla, fused)):
+        assert np.array_equal(want.words, got.words), i
+        np.testing.assert_allclose(
+            got.log_scores, want.log_scores, rtol=1e-4, atol=1e-5,
+        )
+
+
 def test_return_steps_plumbing():
     """return_steps rides beam_search_jit and greedy_decode without
     perturbing results; off by default (None)."""

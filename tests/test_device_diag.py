@@ -319,6 +319,11 @@ def _sync_lint_targets():
     for mod in ("tracectx.py", "promtext.py", "slo.py", "profwin.py",
                 "fleet.py", "blackbox.py"):
         targets.append(os.path.join(REPO, "sat_tpu", "telemetry", mod))
+    # the encoder-quantization pass runs at serve load time inside the
+    # engine boot path: its one-time calibration host syncs must be
+    # declared, and nothing else in it may sync (the quantized encode is
+    # AOT-compiled onto the same async dispatch chain as the fp32 one)
+    targets.append(os.path.join(REPO, "sat_tpu", "nn", "quant.py"))
     return targets
 
 

@@ -127,6 +127,110 @@ def test_beam_search_pallas_kernel_matches_xla(rng, monkeypatch):
     )
 
 
+@pytest.mark.parametrize("B,block_b", [(3, 8), (7, 4), (8, 8), (13, 8)])
+def test_fused_attend_row_mask_geometry(rng, B, block_b):
+    """Slot-pool geometry: odd batch sizes with a dead-row mask.
+
+    Dead rows (inputs poisoned with NaN, as a retired slot's stale carry
+    could be) must come out exactly zero; live rows must stay BITWISE
+    equal to the unmasked kernel; and the masked kernel must agree with
+    the masked XLA reference."""
+    N, da, D = 17, 16, 24
+    t1 = jnp.asarray(rng.normal(size=(B, N, da)).astype(np.float32))
+    t2 = jnp.asarray(rng.normal(size=(B, da)).astype(np.float32))
+    w2 = jnp.asarray(rng.normal(size=(da, 1)).astype(np.float32))
+    ctx = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+    mask = jnp.asarray(rng.integers(0, 2, size=(B,)).astype(bool))
+
+    t1p = t1.at[~mask].set(jnp.nan)
+    t2p = t2.at[~mask].set(jnp.nan)
+    ctxp = ctx.at[~mask].set(jnp.nan)
+
+    got_ctx, got_alpha = fused_attend(
+        t1p, t2p, w2, ctxp, row_mask=mask, interpret=True, block_b=block_b
+    )
+    want_ctx, want_alpha = fused_attend_reference(
+        t1p, t2p, w2, ctxp, row_mask=mask
+    )
+    assert bool(jnp.isfinite(got_ctx).all() and jnp.isfinite(got_alpha).all())
+    dead = np.asarray(~mask)
+    assert (np.asarray(got_ctx)[dead] == 0).all()
+    assert (np.asarray(got_alpha)[dead] == 0).all()
+    np.testing.assert_allclose(got_alpha, want_alpha, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(got_ctx, want_ctx, rtol=1e-5, atol=1e-5)
+
+    live = np.asarray(mask)
+    base_ctx, base_alpha = fused_attend(
+        t1, t2, w2, ctx, interpret=True, block_b=block_b
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_ctx)[live], np.asarray(base_ctx)[live]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_alpha)[live], np.asarray(base_alpha)[live]
+    )
+
+
+def test_fused_attend_all_dead_and_all_live_masks(rng):
+    """Edge masks: all-live equals the unmasked call bitwise; all-dead is
+    all-zero output (never NaN), even at a batch size that needs padding."""
+    B, N, da, D = 5, 17, 16, 24
+    t1 = jnp.asarray(rng.normal(size=(B, N, da)).astype(np.float32))
+    t2 = jnp.asarray(rng.normal(size=(B, da)).astype(np.float32))
+    w2 = jnp.asarray(rng.normal(size=(da, 1)).astype(np.float32))
+    ctx = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+
+    base_ctx, base_alpha = fused_attend(t1, t2, w2, ctx, interpret=True)
+    ctx_l, alpha_l = fused_attend(
+        t1, t2, w2, ctx, row_mask=jnp.ones((B,), bool), interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(ctx_l), np.asarray(base_ctx))
+    np.testing.assert_array_equal(np.asarray(alpha_l), np.asarray(base_alpha))
+
+    ctx_d, alpha_d = fused_attend(
+        jnp.full_like(t1, jnp.nan), jnp.full_like(t2, jnp.nan), w2,
+        jnp.full_like(ctx, jnp.nan), row_mask=jnp.zeros((B,), bool),
+        interpret=True,
+    )
+    assert (np.asarray(ctx_d) == 0).all() and (np.asarray(alpha_d) == 0).all()
+
+
+@pytest.mark.parametrize("layers", [1, 2])
+def test_attend_with_precomputed_row_mask_xla_path(rng, layers):
+    """The XLA fallback (and 1-layer path) apply the same masking
+    semantics as the kernel: live rows bitwise-unchanged, dead rows
+    zeroed even when their inputs are NaN."""
+    config = _cfg(num_attend_layers=layers, use_pallas_attention=False)
+    params = init_decoder_params(jax.random.PRNGKey(0), config)
+    B, N, D = 5, config.num_ctx, config.dim_ctx
+    contexts = jnp.asarray(rng.normal(size=(B, N, D)).astype(np.float32))
+    output = jnp.asarray(
+        rng.normal(size=(B, config.num_lstm_units)).astype(np.float32)
+    )
+    mask = jnp.asarray(np.array([True, False, True, False, True]))
+    proj = precompute_attend(params, config, contexts)
+
+    ctx_base, alpha_base = attend_with_precomputed(
+        params, config, contexts, proj, output
+    )
+    contexts_p = contexts.at[~mask].set(jnp.nan)
+    output_p = output.at[~mask].set(jnp.nan)
+    proj_p = proj.at[~mask].set(jnp.nan)
+    ctx_m, alpha_m = attend_with_precomputed(
+        params, config, contexts_p, proj_p, output_p, row_mask=mask
+    )
+    live, dead = np.asarray(mask), np.asarray(~mask)
+    assert bool(jnp.isfinite(ctx_m).all() and jnp.isfinite(alpha_m).all())
+    assert (np.asarray(ctx_m)[dead] == 0).all()
+    assert (np.asarray(alpha_m)[dead] == 0).all()
+    np.testing.assert_array_equal(
+        np.asarray(ctx_m)[live], np.asarray(ctx_base)[live]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(alpha_m)[live], np.asarray(alpha_base)[live]
+    )
+
+
 def test_fused_attend_bf16_scoring_matches_oracle(rng):
     """compute_dtype='bfloat16' must use bf16 for the scoring matmul in
     both the kernel and the oracle — the default-config path."""
