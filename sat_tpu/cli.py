@@ -63,7 +63,8 @@ def build_config(argv: Optional[List[str]] = None):
         description="TPU-native Show, Attend and Tell",
     )
     p.add_argument(
-        "--phase", default=None, choices=["train", "eval", "test", "serve"],
+        "--phase", default=None,
+        choices=["train", "eval", "test", "serve", "route"],
         help="default: train, or the --config file's phase when one is given",
     )
     p.add_argument(
@@ -200,6 +201,17 @@ def build_config(argv: Optional[List[str]] = None):
              "docs/SERVING.md)",
     )
     p.add_argument(
+        "--replicas", default=None, metavar="HOST:PORT,...",
+        help="route phase: front these pre-started serve replicas instead "
+             "of spawning a local fleet (sat_tpu/serve/router.py)",
+    )
+    p.add_argument(
+        "--num_replicas", type=int, default=None, metavar="N",
+        help="route phase: size of the locally spawned replica fleet "
+             "(ignored when --replicas is given; default "
+             "Config.route_num_replicas)",
+    )
+    p.add_argument(
         "--serve_mode", choices=("batch", "continuous"), default=None,
         help="serve phase: 'batch' dispatches whole padded micro-batches "
              "(the correctness oracle); 'continuous' admits requests into "
@@ -307,8 +319,19 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(trace_export=args.trace_export)
     if args.diag_level is not None:
         config = config.replace(diag_level=args.diag_level)
+    if args.replicas is not None:
+        # naming endpoints implies the route phase (before --port below,
+        # which binds to the router in route phase)
+        config = config.replace(phase="route", route_replicas=args.replicas)
+    if args.num_replicas is not None:
+        config = config.replace(route_num_replicas=args.num_replicas)
     if args.port is not None:
-        config = config.replace(serve_port=args.port)
+        # one --port flag, two listeners: in route phase it is the
+        # router's own port, otherwise the replica's
+        if config.phase == "route":
+            config = config.replace(route_port=args.port)
+        else:
+            config = config.replace(serve_port=args.port)
     if args.max_batch is not None:
         config = config.replace(serve_max_batch=args.max_batch)
     if args.max_wait_ms is not None:
@@ -436,6 +459,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             ),
             backoff_base_s=config.supervise_backoff_s,
         )
+
+    if config.phase == "route":
+        # the fleet router is jax-free by the same contract as the
+        # supervisor parent: it must outlive a replica whose device
+        # runtime wedges, so dispatch before the jax bootstrap below —
+        # the replicas it spawns re-enter this CLI in --phase serve and
+        # own the device stack themselves.
+        from .serve.router import route
+
+        return route(config)
 
     # multi-host bootstrap first, before any other jax use (no-op unless a
     # launcher/env signals a cluster — see parallel.mesh)

@@ -231,6 +231,30 @@ class Config:
     serve_slot_pages: int = 4
     serve_page_width: int = 4
 
+    # ---- fleet router (sat_tpu/serve/router.py; docs/SERVING.md) ----
+    # `--phase route` runs a jax-free health-weighted router over N serve
+    # replicas: spawned locally over a port range when route_replicas is
+    # empty, or pre-started endpoints given as "host:port,host:port".
+    route_port: int = 8800             # router HTTP listen port (0 = ephemeral)
+    route_replicas: str = ""           # endpoint spec; "" = spawn locally
+    route_num_replicas: int = 2        # local-spawn fleet size
+    route_replica_base_port: int = 8710  # local replicas bind base..base+N-1
+    # fleet-view poller cadence: /healthz every tick, the heavier /stats
+    # merge every route_stats_every ticks
+    route_poll_interval_s: float = 0.5
+    route_stats_every: int = 4
+    # the previous pick is kept while its effective load stays within
+    # (1 + hysteresis) of the best — near-ties must not flap picks
+    route_hysteresis: float = 0.25
+    # degraded / straggler replicas multiply their routing weight by this
+    # (down-weighted, never blackholed; both signals compound)
+    route_down_weight: float = 0.25
+    # proactive edge shed: when > 0 and every routable replica's queue is
+    # already this deep, the router sheds with one coherent 429 instead
+    # of forwarding work that would shed N different ways downstream
+    route_shed_depth: int = 0
+    route_upstream_timeout_s: float = 120.0  # per-attempt proxy timeout
+
     # ---- dataset-size caps (reference config.py:60-63) ----
     max_train_ann_num: Optional[int] = 1000
     max_eval_ann_num: Optional[int] = 20
@@ -359,7 +383,7 @@ class Config:
         same, /root/reference/model.py:16-21)."""
         checks = (
             ("cnn", ("vgg16", "resnet50")),
-            ("phase", ("train", "eval", "test", "serve")),
+            ("phase", ("train", "eval", "test", "serve", "route")),
             ("optimizer", ("Adam", "RMSProp", "Momentum", "SGD")),
             ("num_initialize_layers", (1, 2)),
             ("num_attend_layers", (1, 2)),
@@ -466,6 +490,35 @@ class Config:
         if self.serve_slot_pages <= 0 or self.serve_page_width <= 0:
             raise ValueError(
                 "Config.serve_slot_pages and serve_page_width must be >= 1"
+            )
+        if self.route_port < 0 or self.route_replica_base_port < 0:
+            raise ValueError(
+                "Config.route_port and route_replica_base_port must be >= 0"
+            )
+        if self.route_num_replicas <= 0:
+            raise ValueError(
+                f"Config.route_num_replicas={self.route_num_replicas}: "
+                "must be >= 1"
+            )
+        if self.route_poll_interval_s <= 0 or self.route_stats_every <= 0:
+            raise ValueError(
+                "Config.route_poll_interval_s must be > 0 and "
+                "route_stats_every >= 1"
+            )
+        if self.route_hysteresis < 0:
+            raise ValueError(
+                f"Config.route_hysteresis={self.route_hysteresis}: "
+                "must be >= 0"
+            )
+        if not 0 < self.route_down_weight <= 1:
+            raise ValueError(
+                f"Config.route_down_weight={self.route_down_weight}: must "
+                "be in (0, 1] — zero would blackhole degraded replicas"
+            )
+        if self.route_shed_depth < 0 or self.route_upstream_timeout_s <= 0:
+            raise ValueError(
+                "Config.route_shed_depth must be >= 0 and "
+                "route_upstream_timeout_s > 0"
             )
         if (
             self.encoder_quant_calib_batches <= 0

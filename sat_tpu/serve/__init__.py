@@ -18,23 +18,55 @@ Layering:
   gathers whole padded batches, ContinuousBatcher admits into free slots
   between decode steps and detokenizes asynchronously;
 * :mod:`server`    — ThreadingHTTPServer frontend (POST /caption,
-  GET /healthz, GET /stats), drain sequencing, the ``serve()`` CLI entry.
+  GET /healthz, GET /stats), drain sequencing, the ``serve()`` CLI entry;
+* :mod:`replica`   — jax-free replica manager: spawn/monitor N serve
+  subprocesses over a port range, or front pre-started endpoints;
+* :mod:`router`    — jax-free health-weighted HTTP router fronting N
+  replicas: fleet view, hysteretic least-load picks, coherent edge
+  shedding, single cross-replica retry, one-at-a-time drains.
+
+Exports resolve lazily (PEP 562): importing :mod:`router`/:mod:`replica`
+— or this package itself — must not pull jax, because the router process
+is jax-free by contract (same rule as ``--supervise``); only touching an
+engine-side symbol (ServeEngine, CaptionServer, ...) imports the jax
+stack.
 """
 
-from .batcher import ContinuousBatcher, MicroBatcher, Rejected, Request
-from .engine import BucketOverflow, ServeEngine, load_serving_state
-from .server import CaptionServer, serve
-from .slot_pool import PagedSlotPool
+from typing import TYPE_CHECKING
 
-__all__ = [
-    "BucketOverflow",
-    "CaptionServer",
-    "ContinuousBatcher",
-    "MicroBatcher",
-    "PagedSlotPool",
-    "Rejected",
-    "Request",
-    "ServeEngine",
-    "load_serving_state",
-    "serve",
-]
+_LAZY = {
+    "BucketOverflow": ("engine", "BucketOverflow"),
+    "CaptionServer": ("server", "CaptionServer"),
+    "ContinuousBatcher": ("batcher", "ContinuousBatcher"),
+    "MicroBatcher": ("batcher", "MicroBatcher"),
+    "PagedSlotPool": ("slot_pool", "PagedSlotPool"),
+    "Rejected": ("batcher", "Rejected"),
+    "Request": ("batcher", "Request"),
+    "ServeEngine": ("engine", "ServeEngine"),
+    "load_serving_state": ("engine", "load_serving_state"),
+    "serve": ("server", "serve"),
+}
+
+__all__ = sorted(_LAZY)
+
+if TYPE_CHECKING:  # static analyzers see the eager imports
+    from .batcher import ContinuousBatcher, MicroBatcher, Rejected, Request
+    from .engine import BucketOverflow, ServeEngine, load_serving_state
+    from .server import CaptionServer, serve
+    from .slot_pool import PagedSlotPool
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod_name}", __name__), attr)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

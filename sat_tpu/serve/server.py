@@ -210,9 +210,12 @@ class _Handler(BaseHTTPRequestHandler):
             request_id=rid,
         )
         headers = None
-        if status == 429 and "retry_after_ms" in payload:
+        if status in (429, 503) and "retry_after_ms" in payload:
             # RFC 7231 Retry-After is whole seconds; round up so a
-            # compliant client never comes back before the hint
+            # compliant client never comes back before the hint.  One
+            # contract for both shed shapes: 429 queue sheds and 503
+            # drain-rejects carry the same header the router's coherent
+            # edge shed speaks.
             secs = max(1, int(-(-payload["retry_after_ms"] // 1000)))
             headers = {"Retry-After": str(secs)}
         self._reply(status, payload, rid, headers=headers)
@@ -274,6 +277,11 @@ class CaptionServer:
         self._http_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._ready = False
+        # admitted /caption requests resident in this process (queued or
+        # decoding) — a top-level /healthz load signal for the router's
+        # poller alongside queue_depth
+        self._in_flight = 0
+        self._in_flight_lock = threading.Lock()
         # wedged-batch degraded state (docs/SERVING.md): /healthz reports
         # 503 "degraded" while the engine re-warms after a stuck in-flight
         # batch; requests are still admitted (the batcher is alive) — only
@@ -309,6 +317,11 @@ class CaptionServer:
     def ready(self) -> bool:
         return self._ready
 
+    @property
+    def in_flight(self) -> int:
+        with self._in_flight_lock:
+            return self._in_flight
+
     # -- request handlers (HTTP worker threads) ----------------------------
 
     def _finish_request(
@@ -322,6 +335,8 @@ class CaptionServer:
         log gets its record, the SLO error-ratio counters tick, and the
         payload learns its request id."""
         total_ns = time.perf_counter_ns() - trace.t_start_ns
+        with self._in_flight_lock:
+            self._in_flight = max(0, self._in_flight - 1)
         self._tel.count("serve/http_requests")
         if status >= 500:
             self._tel.count("serve/http_5xx")
@@ -341,11 +356,18 @@ class CaptionServer:
         t_req0 = time.perf_counter_ns()
         trace = self.tracer.begin(request_id)
         trace.t_start_ns = t_req0
+        with self._in_flight_lock:
+            self._in_flight += 1  # paired decrement in _finish_request
         if not self._ready:
             return self._finish_request(
                 trace,
                 503,
-                {"error": "server is draining; not accepting work"},
+                {
+                    "error": "server is draining; not accepting work",
+                    # same backoff contract as a 429 shed: tell the
+                    # client when capacity is expected, never 0 seconds
+                    "retry_after_ms": self._retry_hint_ms(),
+                },
             )
         try:
             with self._tel.span("serve/preprocess"):
@@ -382,7 +404,7 @@ class CaptionServer:
             )
         except Rejected as e:
             payload = {"error": e.reason}
-            if e.status == 429:
+            if e.status in (429, 503):
                 payload["retry_after_ms"] = self._retry_hint_ms()
             return self._finish_request(trace, e.status, payload)
         wait_s = (
@@ -395,7 +417,7 @@ class CaptionServer:
             )
         if req.error is not None:
             payload = {"error": req.error[1]}
-            if req.error[0] == 429:
+            if req.error[0] in (429, 503):
                 payload["retry_after_ms"] = self._retry_hint_ms()
             return self._finish_request(
                 trace, req.error[0], payload, bucket=req.bucket
@@ -435,7 +457,13 @@ class CaptionServer:
                     else ("ok" if self._ready else "draining")
                 ),
                 "uptime_s": round(time.time() - self._t_start, 1),
+                # top-level load signals (queue + resident requests +
+                # dispatch mode): the fleet router's poller reads these
+                # from ONE cheap /healthz fetch per tick instead of the
+                # heavier /stats document
                 "queue_depth": self.batcher.queue_depth(),
+                "in_flight": self.in_flight,
+                "serve_mode": self.config.serve_mode,
                 "buckets": list(self.engine.buckets),
                 "model_step": self.engine.step,
             }
@@ -502,6 +530,7 @@ class CaptionServer:
             "ready": self._ready,
             "serve_mode": self.config.serve_mode,
             "queue_depth": self.batcher.queue_depth(),
+            "in_flight": self.in_flight,
             "buckets": list(self.engine.buckets),
             "bucket_histogram": histogram,
             "warm_compiles": self.engine.warm_compiles,

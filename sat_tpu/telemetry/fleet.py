@@ -65,6 +65,32 @@ FLEET_SCALARS = (
 _SIDECAR_RE = re.compile(r"heartbeat_p(\d+)\.json$")
 
 
+def straggler_verdict(named_values: Dict[str, float], factor: float) -> Dict:
+    """The fleet straggler rule, as a pure decision both planes share.
+
+    Train side: :func:`aggregate_rows` feeds per-host step-time p95s;
+    serve side: the router's fleet view (serve/router.py) feeds
+    per-replica request p99s.  With >= 2 reporters and a positive median,
+    the worst reporter is named a straggler when its value STRICTLY
+    exceeds ``median * factor`` — equality is "keeping up".  Returns
+    ``{"verdict": bool, ...}`` with ``name``/``value``/``median``/``skew``
+    when at least one reporter supplied a value."""
+    if not named_values:
+        return {"verdict": False}
+    worst_name = max(named_values, key=lambda k: named_values[k])
+    worst = float(named_values[worst_name])  # sync-ok: host-side JSON scalar
+    median = float(np.median(list(named_values.values())))  # sync-ok: host JSON scalars
+    return {
+        "verdict": (
+            len(named_values) >= 2 and median > 0 and worst > median * factor
+        ),
+        "name": worst_name,
+        "value": round(worst, 4),
+        "median": round(median, 4),
+        "skew": round(worst / median, 4) if median > 0 else 0.0,
+    }
+
+
 def sidecar_path(fleet_dir: str, process_index: int) -> str:
     return os.path.join(fleet_dir, f"heartbeat_p{int(process_index)}.json")
 
@@ -178,19 +204,19 @@ def aggregate_rows(
             vals = [h[key] for h in hosts]
             summary[f"{key}_median"] = round(float(np.median(vals)), 4)  # sync-ok: host JSON scalars
             summary[f"{key}_max"] = round(max(vals), 4)
-        p95s = [h["step_p95_ms"] for h in hosts]
-        median = float(np.median(p95s))  # sync-ok: host JSON scalars
-        worst = max(hosts, key=lambda h: h["step_p95_ms"])
-        skew = worst["step_p95_ms"] / median if median > 0 else 0.0
-        summary["step_p95_skew"] = round(skew, 4)
+        # keys are list positions, not process indices: a duplicate
+        # sidecar index must not collapse two hosts into one dict slot
+        ruling = straggler_verdict(
+            {str(i): h["step_p95_ms"] for i, h in enumerate(hosts)},
+            straggler_factor,
+        )
+        median = ruling["median"]
+        worst = hosts[int(ruling["name"])]
+        skew = ruling["skew"]
+        summary["step_p95_skew"] = skew
         for h in hosts:
             h["skew"] = round(h["step_p95_ms"] / median, 4) if median > 0 else 0.0
-        verdict = (
-            len(hosts) >= 2
-            and median > 0
-            and worst["step_p95_ms"] > median * straggler_factor
-        )
-        if verdict:
+        if ruling["verdict"]:
             doc["straggler"] = {
                 "verdict": True,
                 "process_index": worst["process_index"],

@@ -673,6 +673,169 @@ def serve_wedge_continuous(ctx: Ctx):
             ("wedged_status", "rewarms", "retry_status", "pool_busy_after")}
 
 
+# The fleet kill rehearsal also runs in its own process: spawn a 2-replica
+# LocalFleet + in-process router, SIGKILL one replica mid-load, and prove
+# the router's mark-unreachable + single-retry machinery keeps the edge
+# clean — zero 5xx/connection errors beyond the in-flight window.
+_FLEET_KILL_CHILD = r'''
+import json, os, sys, threading, time, urllib.error, urllib.request
+
+import cv2
+import jax
+import numpy as np
+
+from sat_tpu import runtime, telemetry
+from sat_tpu.config import Config
+from sat_tpu.data.vocabulary import Vocabulary
+from sat_tpu.resilience import lineage
+from sat_tpu.serve.replica import LocalFleet
+from sat_tpu.serve.router import Router
+from sat_tpu.train.checkpoint import save_checkpoint
+from sat_tpu.train.step import create_train_state
+
+workdir = sys.argv[1]
+vocab_file = os.path.join(workdir, "vocabulary.csv")
+vocabulary = Vocabulary(size=30)
+vocabulary.build(["a man riding a horse.", "a cat on a table."])
+vocabulary.save(vocab_file)
+config = Config(
+    phase="serve", image_size=32, dim_embedding=16, num_lstm_units=16,
+    dim_initialize_layer=16, dim_attend_layer=16, dim_decode_layer=32,
+    compute_dtype="float32", vocabulary_size=vocabulary.size,
+    vocabulary_file=vocab_file, beam_size=2,
+    serve_buckets=(1, 4), serve_max_batch=4,
+    save_dir=os.path.join(workdir, "models"),
+    summary_dir=os.path.join(workdir, "summary"),
+    heartbeat_interval=0.0,
+)
+os.makedirs(config.save_dir, exist_ok=True)
+tel = telemetry.enable()
+runtime._install_compile_listener()
+state = create_train_state(jax.random.PRNGKey(0), config)
+save_checkpoint(state, config)
+lineage.mark_last_good(config.save_dir, int(np.asarray(state.step)))
+
+fleet = LocalFleet(config, 2, root=os.path.join(workdir, "fleet"))
+router = None
+try:
+    fleet.wait_ready(timeout_s=300.0)
+    router = Router(
+        config.replace(phase="route", route_poll_interval_s=0.2),
+        fleet.endpoints, fleet=fleet, port=0,
+    ).start()
+    port = router.port
+
+    img = np.random.default_rng(0).integers(
+        0, 255, (32, 32, 3), dtype=np.uint8)
+    ok, buf = cv2.imencode(".jpg", img)
+    jpeg = bytes(buf)
+
+    def post(timeout=60.0):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/caption", data=jpeg, method="POST",
+            headers={"Content-Type": "image/jpeg"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                r.read()
+                return r.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code
+        except (urllib.error.URLError, OSError):
+            return 0
+
+    post()  # warm the edge before measuring
+
+    TOTAL, KILL_AT, RATE = 120, 40, 25.0
+    outcomes, lock, threads = [], threading.Lock(), []
+    kill_time = None
+
+    def fire(i):
+        status = post()
+        with lock:
+            outcomes.append((time.time(), status))
+
+    for i in range(TOTAL):
+        if i == KILL_AT:
+            fleet.replicas[1].kill()  # SIGKILL: sockets die mid-flight
+            kill_time = time.time()
+        t = threading.Thread(target=fire, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(1.0 / RATE)
+    for t in threads:
+        t.join(timeout=120)
+
+    # the in-flight window: requests completing around the kill may have
+    # ridden a socket SIGKILL severed mid-response; everything outside it
+    # must be clean (the router retried them onto the survivor)
+    GRACE_S = 2.0
+    bad = [(t, s) for t, s in outcomes if s == 0 or s >= 500]
+    bad_outside = [
+        (t, s) for t, s in bad
+        if not (kill_time - 0.5 <= t <= kill_time + GRACE_S)
+    ]
+    after = [s for t, s in outcomes if t > kill_time + GRACE_S]
+    deadline = time.time() + 10.0
+    routable = 2
+    while time.time() < deadline:
+        h, code = router.healthz()
+        routable = h["replicas_routable"]
+        if routable == 1:
+            break
+        time.sleep(0.1)
+    print(json.dumps({
+        "total": len(outcomes),
+        "ok": sum(1 for _, s in outcomes if s == 200),
+        "shed": sum(1 for _, s in outcomes if s == 429),
+        "bad_total": len(bad),
+        "bad_outside_window": len(bad_outside),
+        "bad_statuses": sorted({s for _, s in bad}),
+        "post_kill_ok": sum(1 for s in after if s == 200),
+        "retries": tel.counters().get("route/retries", 0),
+        "routable_after": routable,
+    }))
+finally:
+    if router is not None:
+        router.shutdown()
+    fleet.stop_all(timeout_s=30.0)
+'''
+
+
+@scenario
+def fleet_replica_kill(ctx: Ctx):
+    """ISSUE 13 acceptance: SIGKILL one of two router-fronted replicas
+    mid-load; the fleet view marks it unreachable, the single
+    different-replica retry absorbs the severed sockets, and the edge
+    serves zero 5xx beyond the in-flight window."""
+    workdir = os.path.join(ctx.root, "fleet_kill")
+    os.makedirs(workdir, exist_ok=True)
+    proc = subprocess.run(
+        [sys.executable, "-c", _FLEET_KILL_CHILD, workdir],
+        capture_output=True, text=True, cwd=REPO,
+        env=_child_env({}),
+        timeout=_TIMEOUT,
+    )
+    check(proc.returncode == 0,
+          f"fleet kill child rc {proc.returncode}\n"
+          f"{proc.stdout}\n{proc.stderr}")
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    check(result["bad_outside_window"] == 0,
+          f"{result['bad_outside_window']} 5xx/conn-errors beyond the "
+          f"in-flight window (statuses {result['bad_statuses']})")
+    check(result["post_kill_ok"] > 0,
+          "no successful requests after the kill — the survivor never "
+          "absorbed the load")
+    check(result["routable_after"] == 1,
+          f"fleet view still routes {result['routable_after']} replicas "
+          "after the kill, wanted 1")
+    check(result["ok"] + result["shed"] + result["bad_total"]
+          == result["total"], "outcome accounting does not add up")
+    return {k: result[k] for k in
+            ("ok", "shed", "bad_total", "bad_outside_window",
+             "post_kill_ok", "retries", "routable_after")}
+
+
 # -- orchestration ----------------------------------------------------------
 
 

@@ -94,6 +94,12 @@ DEFAULT_MARGINS = {
     # quantizer regression (wrong scale axis, dropped dequant), not noise
     "quant_ctx_rel_err": 1.0,
     "quant_logit_drift": 1.0,
+    # fleet rows ride N subprocess replicas on a shared CPU host — the
+    # noisiest bench family we gate, so the margins are wide; a real
+    # scaling regression moves goodput far more than this
+    "fleet_goodput_rps": 10.0,
+    "fleet_open_loop_p99_latency_ms": 15.0,
+    "fleet_router_overhead_ms": 25.0,
 }
 FALLBACK_MARGIN = 5.0
 
@@ -121,6 +127,7 @@ _HIGHER_BETTER_EXACT = {
     "eval_images_per_sec",
     "shard_feed_speedup",
     "min_speedup",
+    "fleet_goodput_rps",
     "Bleu_4",
     "CIDEr",
     "METEOR",

@@ -97,6 +97,18 @@ if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_quant_serve.json" ]; then
   FAILED="$FAILED bench_quant_serve"
 fi
 
+echo "=== stage 1g: fleet serve (router-fronted goodput scaling at 1/2/4 replicas) ==="
+# spawns max(fleet-sizes) replica subprocesses once, then open-loop load
+# through the jax-free router per fleet size; exits nonzero if any
+# replica recompiled in steady state (budget: replica boots + 3 arms)
+timeout 1200 python scripts/bench_serve.py --fleet \
+  2>"$OUT/fleet_serve.log" | tee "$OUT/fleet_serve.json"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/fleet_serve.json" ]; then
+  echo "STAGE FAILED: fleet_serve (rc=$rc) — see $OUT/fleet_serve.log"
+  FAILED="$FAILED fleet_serve"
+fi
+
 echo "=== stage 2: pallas attention measurement ==="
 timeout 1800 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
 rc=${PIPESTATUS[0]}

@@ -371,6 +371,31 @@ def test_telemetry_core_is_jax_free():
     assert proc.returncode == 0, proc.stderr
 
 
+def test_fleet_router_is_jax_free():
+    """The serving fleet's control plane (router + replica manager, and
+    the lazy serve package itself) must import without jax: like the
+    --supervise parent, the router exists to outlive replicas whose
+    device runtime wedges, so it may never load the device stack.  Only
+    touching an engine-side symbol pulls jax (PEP 562 laziness)."""
+    code = (
+        "import sys\n"
+        "assert 'jax' not in sys.modules\n"
+        "import sat_tpu.serve\n"
+        "from sat_tpu.serve import replica, router\n"
+        "router.replica_weight(True, False, 0.25)\n"
+        "replica.parse_endpoints('127.0.0.1:8710,127.0.0.1:8711')\n"
+        "assert 'jax' not in sys.modules, 'router/replica pulled in jax'\n"
+        "sat_tpu.serve.Rejected\n"
+        "assert 'jax' in sys.modules, 'lazy engine-side export broken'\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=REPO, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
 # ---------------------------------------------------------------------------
 # bench provenance stamp
 # ---------------------------------------------------------------------------
