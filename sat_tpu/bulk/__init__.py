@@ -1,0 +1,41 @@
+"""sat_tpu.bulk — offline bulk captioning at dataset scale (docs/BULK.md).
+
+``--phase bulk`` streams an arbitrary image corpus (directory tree or
+file list, no captions required) through the existing planes and writes
+sharded caption JSONL outputs with an atomically-updated resume
+manifest:
+
+* **input** — corpus walk + shard planning (:mod:`.corpus`), riding the
+  shard-cache build, crc32c row integrity and the quarantine ledger
+  (``data.shards``, ``data.integrity``, ``resilience.quarantine``) so
+  poison images are substituted, never fatal;
+* **decode** — the serve engine's AOT-warmed path (lineage param load,
+  quantize-once, ``PagedSlotPool`` continuous stepped decode) embedded
+  headless, no HTTP (:mod:`.runner`);
+* **output** — ``captions_<shard>.jsonl`` + crc32c sidecars with
+  tmp+rename atomicity (:mod:`.writer`) and the ``bulk_manifest.json``
+  resume frontier (:mod:`.manifest`), making the job crash-only: kill
+  -9 anywhere, relaunch (``--supervise``), completed shards are skipped
+  bitwise-identically.
+
+Only :mod:`.runner` touches jax (lazily, inside ``run_bulk``); the
+corpus/manifest/writer control plane is jax-free so supervisors and
+host-only tools can plan and verify bulk runs without a backend
+(``tests/test_device_diag.py`` enforces this).
+"""
+
+from .corpus import plan_shards, resolve_corpus  # noqa: F401
+from .manifest import (  # noqa: F401
+    corpus_fingerprint,
+    load_manifest,
+    new_manifest,
+    write_manifest,
+)
+from .writer import ShardWriter, shard_filename, verify_shard  # noqa: F401
+
+
+def run_bulk(config, model_file=None):
+    """Lazy re-export: importing :mod:`sat_tpu.bulk` must not pull jax."""
+    from .runner import run_bulk as _run
+
+    return _run(config, model_file=model_file)

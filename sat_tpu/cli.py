@@ -64,7 +64,7 @@ def build_config(argv: Optional[List[str]] = None):
     )
     p.add_argument(
         "--phase", default=None,
-        choices=["train", "eval", "test", "serve", "route"],
+        choices=["train", "eval", "test", "serve", "route", "bulk"],
         help="default: train, or the --config file's phase when one is given",
     )
     p.add_argument(
@@ -229,6 +229,24 @@ def build_config(argv: Optional[List[str]] = None):
              "unquantized path",
     )
     p.add_argument(
+        "--bulk_input", default=None, metavar="PATH",
+        help="bulk phase: image corpus — a directory tree (recursively "
+             "walked for images; non-image files are skipped and counted) "
+             "or a text file listing one image path per line "
+             "(docs/BULK.md)",
+    )
+    p.add_argument(
+        "--bulk_output", default=None, metavar="DIR",
+        help="bulk phase: output directory for captions_<shard>.jsonl + "
+             "crc sidecars and the bulk_manifest.json resume frontier",
+    )
+    p.add_argument(
+        "--bulk_shard_rows", type=int, default=None, metavar="N",
+        help="bulk phase: images per output shard — the resume grain; a "
+             "killed run re-decodes at most one shard (default "
+             "Config.bulk_shard_rows)",
+    )
+    p.add_argument(
         "--supervise", action="store_true",
         help="crash-only restart loop (docs/RESILIENCE.md): keep this "
              "process jax-free and run the real work in a child; a child "
@@ -340,6 +358,12 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(serve_mode=args.serve_mode)
     if args.encoder_quant is not None:
         config = config.replace(encoder_quant=args.encoder_quant)
+    if args.bulk_input is not None:
+        config = config.replace(bulk_input=args.bulk_input)
+    if args.bulk_output is not None:
+        config = config.replace(bulk_output=args.bulk_output)
+    if args.bulk_shard_rows is not None:
+        config = config.replace(bulk_shard_rows=args.bulk_shard_rows)
     if args.watchdog is not None:
         config = config.replace(watchdog_interval=args.watchdog)
     overrides = {}
@@ -549,6 +573,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .serve.server import serve as _serve
 
         return _serve(config, model_file=cli["model_file"])
+    elif config.phase == "bulk":
+        from .bulk.runner import run_bulk
+
+        try:
+            return run_bulk(config, model_file=cli["model_file"])
+        except SimulatedPreemption as e:
+            # injected die-at-step-k: behave like a real preemption — the
+            # supervisor relaunches and the manifest frontier resumes
+            print(f"sat_tpu: {e}", file=sys.stderr, flush=True)
+            _postmortem("simulated_preemption", 1, error=str(e))
+            return 1
+        except SystemicCorruption as e:
+            # quarantine ceiling: the corpus is rotten, not the process —
+            # exit 87, which the supervisor refuses to restart
+            print(f"sat_tpu: FATAL: {e}", file=sys.stderr, flush=True)
+            _postmortem(
+                "systemic_corruption", DATA_CORRUPTION_EXIT_CODE, error=str(e)
+            )
+            return DATA_CORRUPTION_EXIT_CODE
+        except Exception as e:
+            _postmortem("uncaught_exception", None, error=repr(e))
+            raise
     elif config.phase == "eval":
         if cli["sweep"]:
             sweep = runtime.evaluate_sweep(config)

@@ -255,6 +255,14 @@ class Config:
     route_shed_depth: int = 0
     route_upstream_timeout_s: float = 120.0  # per-attempt proxy timeout
 
+    # ---- bulk offline captioning (sat_tpu/bulk; docs/BULK.md) ----
+    # `--phase bulk` streams an arbitrary image corpus through the serve
+    # engine's AOT-warmed continuous stepped decode and writes sharded
+    # caption JSONL outputs with a crash-only resume manifest.
+    bulk_input: str = ""               # corpus: directory tree or file list
+    bulk_output: str = ""              # output dir (captions_*.jsonl + manifest)
+    bulk_shard_rows: int = 256         # images per output shard (resume grain)
+
     # ---- dataset-size caps (reference config.py:60-63) ----
     max_train_ann_num: Optional[int] = 1000
     max_eval_ann_num: Optional[int] = 20
@@ -383,7 +391,7 @@ class Config:
         same, /root/reference/model.py:16-21)."""
         checks = (
             ("cnn", ("vgg16", "resnet50")),
-            ("phase", ("train", "eval", "test", "serve", "route")),
+            ("phase", ("train", "eval", "test", "serve", "route", "bulk")),
             ("optimizer", ("Adam", "RMSProp", "Momentum", "SGD")),
             ("num_initialize_layers", (1, 2)),
             ("num_attend_layers", (1, 2)),
@@ -410,6 +418,10 @@ class Config:
         if self.heartbeat_interval < 0:
             raise ValueError(
                 f"Config.heartbeat_interval={self.heartbeat_interval}: must be >= 0"
+            )
+        if self.bulk_shard_rows < 1:
+            raise ValueError(
+                f"Config.bulk_shard_rows={self.bulk_shard_rows}: must be >= 1"
             )
         if not 0 < self.quarantine_max_fraction <= 1:
             raise ValueError(

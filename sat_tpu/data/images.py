@@ -34,6 +34,40 @@ from ..resilience.quarantine import QuarantineManager, SystemicCorruption
 # matches np.load('ilsvrc_2012_mean.npy').mean(1).mean(1) in the reference.
 ILSVRC_2012_MEAN = np.array([104.00698793, 116.66876762, 122.67891434], np.float32)
 
+# Suffixes cv2.imread is expected to decode; everything else in a walked
+# directory (READMEs, .DS_Store, sidecar JSONs) is skipped, not an error.
+IMAGE_SUFFIXES = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+
+def walk_images(root: str) -> List[str]:
+    """Deterministic recursive walk of ``root`` returning every image file
+    (by suffix, case-insensitive) in sorted absolute-path order.
+
+    Real corpora directories are mixed-content — checksum manifests,
+    thumbnails databases, editor droppings live next to the JPEGs — and a
+    bulk job that raises on the first ``README.txt`` three hours in is
+    useless.  Non-image files are skipped and counted on the named
+    ``data/skipped_nonimage`` counter so the skip volume is observable
+    (heartbeat/bench) instead of silent.  The sort is over the final
+    absolute paths, so the corpus order — and hence the bulk manifest
+    fingerprint (bulk.manifest) — is independent of os.walk's directory
+    visit order.
+    """
+    import os
+
+    files: List[str] = []
+    skipped = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()  # deterministic descent (cosmetic; final sort rules)
+        for name in filenames:
+            if name.lower().endswith(IMAGE_SUFFIXES):
+                files.append(os.path.abspath(os.path.join(dirpath, name)))
+            else:
+                skipped += 1
+    if skipped:
+        telemetry.get().count("data/skipped_nonimage", skipped)
+    return sorted(files)
+
 
 class ImageLoader:
     """raw=True defers the astype(float32)−mean step to the accelerator

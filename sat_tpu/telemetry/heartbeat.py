@@ -165,6 +165,17 @@ class Heartbeat:
         }
         if data:
             payload["data"] = data
+        # bulk offline-captioning progress (sat_tpu/bulk): images done /
+        # total, captions/s, ETA, quarantined count, steady-state compile
+        # count — the heartbeat is how a watcher tracks a dataset-scale
+        # job without tailing its log
+        bulk = {
+            k[len("bulk/"):]: v
+            for k, v in gauges.items()
+            if k.startswith("bulk/")
+        }
+        if bulk:
+            payload["bulk"] = bulk
         # SLO engine state (telemetry.slo): per-objective burn rate and
         # burning flag plus the burning_total roll-up — the heartbeat is
         # where an outside watcher sees an objective start to burn

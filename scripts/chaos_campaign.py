@@ -836,6 +836,159 @@ def fleet_replica_kill(ctx: Ctx):
              "post_kill_ok", "retries", "routable_after")}
 
 
+# -- bulk offline captioning (ISSUE 14) -------------------------------------
+#
+# Both bulk scenarios decode the fixture's train images through the
+# --phase bulk pipeline, which needs a blessed checkpoint: one short seed
+# train, memoized on the Ctx so --only runs stay self-contained without
+# every scenario paying for its own.
+
+
+def _bulk_checkpoint(ctx: Ctx) -> str:
+    """Train the tiny model once; returns the blessed save_dir."""
+    if not hasattr(ctx, "_bulk_save_dir"):
+        cfg = ctx.cfg("bulk_seed")
+        _check_clean(ctx.launch(cfg, name="bulk_seed"), "bulk seed train")
+        check(lineage.last_good_step(cfg.save_dir) == 6,
+              "bulk seed train left no LAST_GOOD checkpoint")
+        ctx._bulk_save_dir = cfg.save_dir
+    return ctx._bulk_save_dir
+
+
+def _bulk_cfg(ctx: Ctx, name: str, **kw):
+    return ctx.cfg(
+        name,
+        phase="bulk",
+        save_dir=_bulk_checkpoint(ctx),
+        bulk_input=ctx.fix["train_img_dir"],
+        bulk_output=os.path.join(ctx.root, name, "out"),
+        bulk_shard_rows=4,
+        shard_cache="off",
+        beam_size=2,
+        serve_slot_pages=2,
+        serve_page_width=2,
+        **kw,
+    )
+
+
+def _bulk_outputs(out_dir: str):
+    """{basename: bytes} of every committed caption shard + sidecar."""
+    blobs = {}
+    for fname in sorted(os.listdir(out_dir)):
+        if fname.startswith("captions_") and not fname.endswith(".tmp"):
+            with open(os.path.join(out_dir, fname), "rb") as f:
+                blobs[fname] = f.read()
+    return blobs
+
+
+@scenario
+def bulk_preempt_resume(ctx: Ctx):
+    """SAT_FI_DIE_AT_STEP (abrupt death mid-corpus) under --supervise:
+    the supervisor relaunches, resume verifies + skips the committed
+    output shards, re-decodes the interrupted one, and the final output
+    files are bitwise-identical to an uninterrupted control run."""
+    import re
+
+    control = _bulk_cfg(ctx, "bulk_control")
+    _check_clean(ctx.launch(control, name="bulk_control"),
+                 "control bulk run")
+    control_blobs = _bulk_outputs(control.bulk_output)
+    check(len(control_blobs) == 6,  # 3 shards x (jsonl + crc sidecar)
+          f"control run committed {sorted(control_blobs)}, wanted 3 shards")
+    # the control heartbeat carries the deterministic fault-injection
+    # clock — aim the kill mid-corpus, past the first shard commit
+    total_steps = _heartbeat(control).get("bulk", {}).get("decode_steps")
+    check(total_steps and total_steps >= 3,
+          f"control heartbeat lacks bulk/decode_steps: {total_steps}")
+    die_at = max(2, total_steps // 2)
+
+    cfg = _bulk_cfg(ctx, "bulk_preempt", supervise_backoff_s=0.1)
+    proc = ctx.launch(cfg, "--supervise",
+                      env={"SAT_FI_DIE_AT_STEP": str(die_at)},
+                      name="bulk_preempt")
+    _check_clean(proc, "supervised bulk run")
+    check("restarting from LAST_GOOD" in proc.stderr,
+          "supervisor never restarted the killed bulk run")
+    resumed = [int(m.group(1)) for m in
+               re.finditer(r"\((\d+) already complete", proc.stderr)]
+    check(len(resumed) >= 2 and max(resumed) >= 1,
+          f"resume frontier never skipped a committed shard: {resumed} "
+          f"(die_at={die_at})")
+    blobs = _bulk_outputs(cfg.bulk_output)
+    check(set(blobs) == set(control_blobs),
+          f"output file sets differ: {sorted(blobs)} vs "
+          f"{sorted(control_blobs)}")
+    for fname in control_blobs:
+        check(blobs[fname] == control_blobs[fname],
+              f"{fname} differs between interrupted-and-resumed and "
+              "uninterrupted runs")
+    return {"die_at_step": die_at, "restarts":
+            proc.stderr.count("restarting from LAST_GOOD"),
+            "shards_skipped_on_resume": max(resumed)}
+
+
+@scenario
+def bulk_poison_quarantine(ctx: Ctx):
+    """SAT_FI_BAD_IMAGE_EVERY through --phase bulk: poison images are
+    ledgered and substituted (job completes, rc 0, quarantine marked in
+    the output rows) — and past the systemic ceiling the job exits 87
+    and the supervisor refuses to restart it."""
+    ledger = os.path.join(ctx.root, "bulk_poison_ledger.jsonl")
+    cfg = _bulk_cfg(ctx, "bulk_poison", quarantine_ledger=ledger)
+    # EVERY=6 poisons exactly one fixture basename (crc32 % 6 == 0):
+    # contained — 1/12 rows is far below the 0.5 default ceiling
+    proc = ctx.launch(cfg, env={"SAT_FI_BAD_IMAGE_EVERY": "6"},
+                      name="bulk_poison")
+    _check_clean(proc, "poisoned bulk run")
+    entries = _read_ledger(ledger)
+    check(entries, "quarantine ledger is empty")
+    check(all(e.get("kind") == "image" for e in entries),
+          f"unexpected ledger kinds: {entries}")
+    hb = _heartbeat(cfg)
+    check(hb.get("bulk", {}).get("quarantined", 0) >= 1,
+          f"heartbeat bulk gauges missing quarantine: {hb.get('bulk')}")
+    quarantined_rows = []
+    for fname, blob in _bulk_outputs(cfg.bulk_output).items():
+        if fname.endswith(".jsonl"):
+            for line in blob.splitlines():
+                row = json.loads(line)
+                if row.get("quarantined"):
+                    quarantined_rows.append(row)
+    check(len(quarantined_rows) == len(entries),
+          f"{len(entries)} ledger entries but {len(quarantined_rows)} "
+          "substituted output rows")
+    check(all(r.get("substituted_from") for r in quarantined_rows),
+          f"substituted rows lack provenance: {quarantined_rows}")
+
+    # ceiling variant: 8 inherited ledger entries + fraction 0.1 — the
+    # one new quarantine crosses the ceiling, 87 is terminal under
+    # --supervise (same contract as quarantine_ceiling for training)
+    ceiling_ledger = os.path.join(ctx.root, "bulk_ceiling_ledger.jsonl")
+    with open(ceiling_ledger, "w") as f:
+        for i in range(8):
+            f.write(json.dumps({
+                "file": f"/decommissioned/rotten_{i}.jpg",
+                "reason": "decode_failed", "kind": "image", "sha": None,
+            }) + "\n")
+    ceil_cfg = _bulk_cfg(ctx, "bulk_ceiling",
+                         quarantine_ledger=ceiling_ledger,
+                         quarantine_max_fraction=0.1,
+                         supervise_backoff_s=0.1)
+    proc = ctx.launch(ceil_cfg, "--supervise",
+                      env={"SAT_FI_BAD_IMAGE_EVERY": "6"},
+                      name="bulk_ceiling")
+    check(proc.returncode == DATA_CORRUPTION_EXIT_CODE,
+          f"rc {proc.returncode} != {DATA_CORRUPTION_EXIT_CODE}\n"
+          f"{proc.stdout}\n{proc.stderr}")
+    check("FATAL" in proc.stderr, "no FATAL notice")
+    check("not restarting" in proc.stderr,
+          "supervisor restarted a systemically corrupt bulk run")
+    check(len(_read_ledger(ceiling_ledger)) == 9,
+          "ceiling quarantine never appended")
+    return {"ledger_entries": len(entries),
+            "substituted_rows": len(quarantined_rows)}
+
+
 # -- orchestration ----------------------------------------------------------
 
 

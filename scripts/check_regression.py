@@ -100,6 +100,10 @@ DEFAULT_MARGINS = {
     "fleet_goodput_rps": 10.0,
     "fleet_open_loop_p99_latency_ms": 15.0,
     "fleet_router_overhead_ms": 25.0,
+    # bulk rows time whole CLI subprocesses (jax boot + checkpoint load +
+    # decode) on a shared CPU host — wide margins like the fleet family
+    "bulk_throughput_captions_s": 10.0,
+    "bulk_resume_overhead_s": 25.0,
 }
 FALLBACK_MARGIN = 5.0
 

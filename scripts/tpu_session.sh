@@ -109,6 +109,17 @@ if [ "$rc" -ne 0 ] || [ ! -s "$OUT/fleet_serve.json" ]; then
   FAILED="$FAILED fleet_serve"
 fi
 
+echo "=== stage 1h: bulk offline captioning (throughput + resume overhead) ==="
+# three CLI child runs (seed checkpoint, decode, resume); exits nonzero
+# if the decode loop recompiled in steady state
+timeout 900 python scripts/bench_bulk.py \
+  2>"$OUT/bench_bulk.log" | tee "$OUT/bench_bulk.json"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_bulk.json" ]; then
+  echo "STAGE FAILED: bench_bulk (rc=$rc) — see $OUT/bench_bulk.log"
+  FAILED="$FAILED bench_bulk"
+fi
+
 echo "=== stage 2: pallas attention measurement ==="
 timeout 1800 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
 rc=${PIPESTATUS[0]}

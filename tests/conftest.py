@@ -32,6 +32,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (pytest -m 'not slow')",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _fault_injection_inert():
     """Fault injection must be opt-in per test: no SAT_FI_* variable may

@@ -303,7 +303,9 @@ def _sync_lint_targets():
     prefetch producers and the integrity verifier run host-side work
     that must never touch a device value."""
     targets = [os.path.join(REPO, "sat_tpu", "runtime.py")]
-    for sub in ("serve", "resilience", "data"):
+    # bulk rides the serve drain discipline: its decode loop drains the
+    # slot-pool done flags whole-array, so it lints like serve does
+    for sub in ("serve", "resilience", "data", "bulk"):
         sub_dir = os.path.join(REPO, "sat_tpu", sub)
         targets.extend(
             os.path.join(sub_dir, f)
@@ -387,6 +389,30 @@ def test_fleet_router_is_jax_free():
         "assert 'jax' not in sys.modules, 'router/replica pulled in jax'\n"
         "sat_tpu.serve.Rejected\n"
         "assert 'jax' in sys.modules, 'lazy engine-side export broken'\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=REPO, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_bulk_control_plane_is_jax_free():
+    """The bulk subsystem's control plane (corpus walk, shard plan,
+    manifest, output writer — everything resume touches before deciding
+    there is work) must import and run without jax: a resume that finds
+    all shards complete exits without ever booting the device runtime,
+    and the --supervise parent may import the package for diagnostics."""
+    code = (
+        "import sys\n"
+        "assert 'jax' not in sys.modules\n"
+        "import sat_tpu.bulk\n"
+        "from sat_tpu.bulk import corpus, manifest, runner, writer\n"
+        "manifest.corpus_fingerprint(['a.jpg'], 4, 32)\n"
+        "corpus.plan_shards(['a.jpg', 'b.jpg'], 1)\n"
+        "writer.shard_filename(3)\n"
+        "assert 'jax' not in sys.modules, 'bulk control plane pulled in jax'\n"
     )
     proc = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
