@@ -229,6 +229,30 @@ def build_config(argv: Optional[List[str]] = None):
              "unquantized path",
     )
     p.add_argument(
+        "--model_reload", type=float, default=None, metavar="SEC",
+        help="serve phase: poll the lineage LAST_GOOD pointer every SEC "
+             "seconds (jittered) and hot-swap new checkpoints through a "
+             "canary stage without restarting the server (0 = off, the "
+             "load-once default; docs/SERVING.md 'Model lifecycle')",
+    )
+    p.add_argument(
+        "--canary_fraction", type=float, default=None, metavar="F",
+        help="serve phase: fraction of requests routed to the candidate "
+             "params during the canary window, sticky per X-Request-Id "
+             "(default Config.canary_fraction)",
+    )
+    p.add_argument(
+        "--canary_window_s", type=float, default=None, metavar="SEC",
+        help="serve phase: canary qualification window length before "
+             "promote/rollback is decided (default Config.canary_window_s)",
+    )
+    p.add_argument(
+        "--promote_policy", choices=("auto", "manual"), default=None,
+        help="serve phase: 'auto' promotes a candidate whose canary window "
+             "elapsed without the canary SLO burning; 'manual' holds in "
+             "CANARY until POST /promote or /rollback",
+    )
+    p.add_argument(
         "--bulk_input", default=None, metavar="PATH",
         help="bulk phase: image corpus — a directory tree (recursively "
              "walked for images; non-image files are skipped and counted) "
@@ -358,6 +382,14 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(serve_mode=args.serve_mode)
     if args.encoder_quant is not None:
         config = config.replace(encoder_quant=args.encoder_quant)
+    if args.model_reload is not None:
+        config = config.replace(model_reload=args.model_reload)
+    if args.canary_fraction is not None:
+        config = config.replace(canary_fraction=args.canary_fraction)
+    if args.canary_window_s is not None:
+        config = config.replace(canary_window_s=args.canary_window_s)
+    if args.promote_policy is not None:
+        config = config.replace(promote_policy=args.promote_policy)
     if args.bulk_input is not None:
         config = config.replace(bulk_input=args.bulk_input)
     if args.bulk_output is not None:

@@ -231,6 +231,31 @@ class Config:
     serve_slot_pages: int = 4
     serve_page_width: int = 4
 
+    # ---- model lifecycle (sat_tpu/lifecycle; docs/SERVING.md) ----
+    # zero-downtime model refresh: a reloader thread polls the lineage
+    # LAST_GOOD pointer every model_reload seconds (jittered) and stages
+    # any new checkpoint through load -> canary -> promote/rollback
+    # without restarting the server.  0 = lifecycle plane off (the
+    # load-once behavior).
+    model_reload: float = 0.0
+    # fraction of admitted requests routed to the candidate params during
+    # the canary window (deterministic per X-Request-Id hash, so retries
+    # of one request always land on the same slot)
+    canary_fraction: float = 0.1
+    # qualification window: how long a candidate serves canary traffic
+    # before the controller decides promote (auto) or awaits the operator
+    canary_window_s: float = 30.0
+    # "auto" promotes when the window elapses without the canary SLO
+    # burning; "manual" holds in CANARY until POST /promote (or /rollback)
+    promote_policy: str = "auto"
+    # fraction of incumbent requests shadow-duplicated onto the candidate
+    # to feed the caption-divergence gauge (device cost, off the request
+    # path — the client gets the incumbent answer either way)
+    canary_shadow_rate: float = 0.1
+    # divergence ceiling for lifecycle/caption_divergence (token Jaccard
+    # distance EWMA vs the incumbent, 0..1); 0 disables the objective
+    canary_divergence_max: float = 0.0
+
     # ---- fleet router (sat_tpu/serve/router.py; docs/SERVING.md) ----
     # `--phase route` runs a jax-free health-weighted router over N serve
     # replicas: spawned locally over a port range when route_replicas is
@@ -502,6 +527,35 @@ class Config:
         if self.serve_slot_pages <= 0 or self.serve_page_width <= 0:
             raise ValueError(
                 "Config.serve_slot_pages and serve_page_width must be >= 1"
+            )
+        if self.model_reload < 0:
+            raise ValueError(
+                f"Config.model_reload={self.model_reload}: must be >= 0 "
+                "(0 = lifecycle off)"
+            )
+        if not 0 <= self.canary_fraction <= 1:
+            raise ValueError(
+                f"Config.canary_fraction={self.canary_fraction}: must be "
+                "in [0, 1]"
+            )
+        if self.canary_window_s <= 0:
+            raise ValueError(
+                f"Config.canary_window_s={self.canary_window_s}: must be > 0"
+            )
+        if self.promote_policy not in ("auto", "manual"):
+            raise ValueError(
+                f"Config.promote_policy={self.promote_policy!r}: must be "
+                "'auto' or 'manual'"
+            )
+        if not 0 <= self.canary_shadow_rate <= 1:
+            raise ValueError(
+                f"Config.canary_shadow_rate={self.canary_shadow_rate}: "
+                "must be in [0, 1]"
+            )
+        if not 0 <= self.canary_divergence_max <= 1:
+            raise ValueError(
+                f"Config.canary_divergence_max={self.canary_divergence_max}: "
+                "must be in [0, 1] (a Jaccard distance; 0 = off)"
             )
         if self.route_port < 0 or self.route_replica_base_port < 0:
             raise ValueError(

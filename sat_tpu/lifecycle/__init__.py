@@ -1,0 +1,33 @@
+"""Zero-downtime model lifecycle: hot-swap reloader, canary routing,
+SLO-gated promote/rollback.
+
+The package is jax-free at import time (the loader defers its jax
+imports) so the control plane — reloader, canary hash, controller state
+machine — runs on jax-free hosts: the router, admin tooling, unit tests.
+"""
+
+from .canary import (
+    CANARY,
+    INCUMBENT,
+    DivergenceGauge,
+    assign_slot,
+    caption_divergence,
+    request_weight,
+)
+from .controller import STATE_CODES, STATES, LifecycleController
+from .loader import load_candidate
+from .reloader import Reloader
+
+__all__ = [
+    "CANARY",
+    "INCUMBENT",
+    "DivergenceGauge",
+    "LifecycleController",
+    "Reloader",
+    "STATES",
+    "STATE_CODES",
+    "assign_slot",
+    "caption_divergence",
+    "load_candidate",
+    "request_weight",
+]

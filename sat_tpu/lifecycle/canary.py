@@ -1,0 +1,78 @@
+"""Canary routing + caption-divergence scoring — pure host functions.
+
+Routing is a **deterministic hash** of the X-Request-Id: a retry of the
+same request (same id, per the tracing contract) always lands on the
+same param slot, so a client retrying into the canary window can't
+flap between two models mid-conversation, and tests can pick ids that
+provably land on either side of the fraction.  No RNG, no state.
+
+Divergence is a token-level Jaccard distance between the incumbent's
+and the candidate's captions for the SAME image (shadow-sampled by the
+controller): 0 = identical token sets, 1 = disjoint.  It is the cheap
+"did the model change what it says" signal that p99/error-rate SLOs
+cannot see — a candidate can be fast, error-free, and caption every
+image as "a a a a".  Jax-free: the lifecycle control plane imports
+this module in the router and in jax-free tooling.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+INCUMBENT = "incumbent"
+CANARY = "canary"
+
+# 8 hex digits of the sha256 -> a uniform draw in [0, 1) with 2^32 grain
+_HASH_DENOM = float(1 << 32)  # sync-ok: host constant, no device value
+
+
+def request_weight(request_id: str) -> float:
+    """The request's deterministic position in [0, 1): requests below
+    ``canary_fraction`` route to the candidate."""
+    digest = hashlib.sha256(request_id.encode("utf-8")).hexdigest()
+    return int(digest[:8], 16) / _HASH_DENOM
+
+
+def assign_slot(request_id: Optional[str], fraction: float) -> str:
+    """Which param slot serves ``request_id`` at this canary fraction.
+    Sticky: the same id maps to the same slot for any fixed fraction,
+    and a slot assigned at fraction f stays canary at any fraction > f
+    (the hash is a fixed position, the fraction a moving threshold)."""
+    if not request_id or fraction <= 0:
+        return INCUMBENT
+    if fraction >= 1:
+        return CANARY
+    return CANARY if request_weight(request_id) < fraction else INCUMBENT
+
+
+def caption_divergence(incumbent: str, candidate: str) -> float:
+    """Token Jaccard distance between two captions in [0, 1]."""
+    a = set(incumbent.split())
+    b = set(candidate.split())
+    if not a and not b:
+        return 0.0
+    union = a | b
+    if not union:
+        return 0.0
+    return 1.0 - len(a & b) / len(union)
+
+
+class DivergenceGauge:
+    """EWMA of shadow-pair divergences; one float of state, no locks
+    needed beyond the GIL (single shadow worker updates it)."""
+
+    def __init__(self, alpha: float = 0.3) -> None:
+        self.alpha = float(alpha)  # sync-ok: host config scalar
+        self.value: Optional[float] = None
+        self.samples = 0
+
+    def update(self, divergence: float) -> float:
+        d = min(1.0, max(0.0, float(divergence)))  # sync-ok: host scalar
+        self.value = (
+            d
+            if self.value is None
+            else self.alpha * d + (1 - self.alpha) * self.value
+        )
+        self.samples += 1
+        return self.value

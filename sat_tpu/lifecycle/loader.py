@@ -1,0 +1,129 @@
+"""Candidate checkpoint loading for the hot-swap path.
+
+Loads a lineage-blessed checkpoint into the engine's SECOND param slot
+while the incumbent keeps serving, with every guard that can fail doing
+so BEFORE device memory is spent:
+
+1. lineage integrity verify (sidecar sha256 / zip CRC walk);
+2. vocabulary-fingerprint fail-fast (``VocabMismatchError`` — a
+   candidate trained against a different vocabulary would caption in
+   gibberish, silently);
+3. host-side flat load (``checkpoint.load_flat`` — numpy, no device);
+4. quantize-once on the HOST tree when the engine serves quantized:
+   ``quant.quantize_encoder`` folds BN and quantizes from the numpy
+   arrays directly, so the candidate's fp32 CNN is **never resident on
+   device** — only the small qcnn kernels land.  (The incumbent already
+   dropped its own fp32 CNN at startup; without this, a reload would be
+   the one moment two full fp32 encoders sat in HBM.)
+5. full-coverage device placement against the incumbent's tree: every
+   incumbent leaf must be fed by the checkpoint (tolerant partial
+   restore is right for training resume, wrong for a model that will
+   serve traffic), cast to the incumbent dtype so the warmed
+   executables' avals match exactly.
+
+Jax is imported inside functions only — the lifecycle package stays
+importable on jax-free hosts (router tooling, unit tests).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Dict, Tuple
+
+from ..resilience import lineage
+
+
+def _nest_flat(
+    flat: Dict[str, Any], prefixes: Tuple[str, ...]
+) -> Dict[str, Any]:
+    """``{"params/cnn/conv1/kernel": arr}`` → nested host-numpy dicts,
+    keeping only keys under ``prefixes``.  The skeleton
+    ``quant.quantize_encoder`` walks."""
+    out: Dict[str, Any] = {}
+    for key, value in flat.items():
+        if not any(key.startswith(p) for p in prefixes):
+            continue
+        parts = key.split("/")
+        node = out
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return out
+
+
+def load_candidate(engine, config, path: str) -> Dict[str, Any]:
+    """Load ``path`` as a hot-swap candidate for ``engine``.
+
+    Returns ``{"variables", "decoder_params", "step", "source"}`` ready
+    for ``engine.install_candidate``.  Raises ``ValueError`` (integrity /
+    coverage / geometry) or ``VocabMismatchError`` — the controller maps
+    any raise to a lineage rejection.
+    """
+    import jax
+
+    from ..data.vocabulary import vocab_fingerprint
+    from ..train import checkpoint
+
+    ok, reason = lineage.verify_checkpoint(path)
+    if not ok:
+        raise ValueError(f"candidate {path} failed verification: {reason}")
+    expect = vocab_fingerprint(config.vocabulary_file, config.vocabulary_size)
+    checkpoint._check_vocab(path, expect)  # raises VocabMismatchError
+
+    t0 = time.perf_counter()
+    flat = checkpoint.load_flat(path)  # host numpy only
+    step = int(flat.get("global_step", 0))
+
+    def _place_full(template, prefix: str, what: str):
+        """Device-place the checkpoint's leaves in the incumbent tree's
+        structure, requiring FULL coverage (every template leaf fed)."""
+        tree, count = checkpoint._assign_leaves(template, prefix, flat)
+        total = len(jax.tree_util.tree_leaves(template))
+        if count != total:
+            raise ValueError(
+                f"candidate {os.path.basename(path)} covers {count}/"
+                f"{total} {what} tensors of the serving model — partial "
+                "or geometry-drifted checkpoint, rejecting"
+            )
+        return tree
+
+    if engine.encoder_quant != "off":
+        # quantize from the HOST tree: the candidate's fp32 CNN stays in
+        # host memory; only the quantized kernels are device arrays
+        host_vars = _nest_flat(flat, ("params/", "batch_stats/"))
+        if "cnn" not in host_vars.get("params", {}):
+            raise ValueError(
+                f"candidate {os.path.basename(path)} has no params/cnn "
+                "tree to quantize, rejecting"
+            )
+        from ..nn import quant
+
+        qcnn = quant.quantize_encoder(host_vars, config)
+        decoder_params = _place_full(
+            engine.slot_decoder_params("incumbent"),
+            "params/decoder/",
+            "decoder",
+        )
+        variables = {"params": {"decoder": decoder_params}, "qcnn": qcnn}
+    else:
+        variables = _place_full(
+            engine.slot_variables("incumbent"), "", "model"
+        )
+        decoder_params = variables["params"]["decoder"]
+    jax.block_until_ready(jax.tree_util.tree_leaves(decoder_params)[0])  # sync-ok: candidate load path, off the request path
+    load_s = time.perf_counter() - t0
+    print(
+        f"sat_tpu: lifecycle candidate {os.path.basename(path)} "
+        f"(step {step}) staged in {load_s:.2f}s",
+        file=sys.stderr,
+        flush=True,
+    )
+    return {
+        "variables": variables,
+        "decoder_params": decoder_params,
+        "step": step,
+        "source": path,
+        "load_seconds": load_s,
+    }

@@ -50,6 +50,12 @@ Knobs::
                                degraded-but-alive serving device; the
                                latency SLO must start burning while the
                                wedge watchdog stays quiet)
+    SAT_FI_CANARY_SLOW_MS=m    like SLOW_SERVE_MS but only for batches
+                               dispatched against the CANARY param slot
+                               (a bad candidate checkpoint whose decode
+                               path stalls; the canary SLO must burn and
+                               the lifecycle controller must roll back
+                               while the incumbent stays fast)
     SAT_FI_CORRUPT_SHARD_ROW=k overwrite the first bytes of row k of
                                shard-00000.npy when the shard cache is
                                resolved (bit rot in a data shard; the
@@ -120,6 +126,7 @@ class FaultPlan:
     slow_step_ms: Optional[int] = None
     wedge_serve_batch: Optional[int] = None
     slow_serve_ms: Optional[int] = None
+    canary_slow_ms: Optional[int] = None
     corrupt_shard_row: Optional[int] = None
     bad_image_every: Optional[int] = None
     bad_caption_at: Optional[int] = None
@@ -137,6 +144,7 @@ class FaultPlan:
             slow_step_ms=_env_int(env, "SLOW_STEP_MS"),
             wedge_serve_batch=_env_int(env, "WEDGE_SERVE_BATCH"),
             slow_serve_ms=_env_int(env, "SLOW_SERVE_MS"),
+            canary_slow_ms=_env_int(env, "CANARY_SLOW_MS"),
             corrupt_shard_row=_env_int(env, "CORRUPT_SHARD_ROW"),
             bad_image_every=_env_int(env, "BAD_IMAGE_EVERY"),
             bad_caption_at=_env_int(env, "BAD_CAPTION_AT"),
@@ -153,6 +161,7 @@ class FaultPlan:
             and self.slow_step_ms is None
             and self.wedge_serve_batch is None
             and self.slow_serve_ms is None
+            and self.canary_slow_ms is None
             and self.corrupt_shard_row is None
             and self.bad_image_every is None
             and self.bad_caption_at is None
@@ -218,6 +227,15 @@ class FaultPlan:
         if self.slow_serve_ms is None:
             return
         time.sleep(self.slow_serve_ms / 1e3)
+
+    def maybe_slow_canary(self, slot: str) -> None:
+        """At the serve result drain, when the drained batch ran against
+        the canary param slot: stall ``canary_slow_ms`` of host time.
+        The incumbent slot is untouched, so the canary SLO burns while
+        the serve SLO stays green — the rollback trigger."""
+        if self.canary_slow_ms is None or slot != "canary":
+            return
+        time.sleep(self.canary_slow_ms / 1e3)
 
     def maybe_wedge_serve(self, batch_index: int) -> bool:
         """At the serve result drain, for the ``batch_index``-th (1-based)

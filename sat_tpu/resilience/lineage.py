@@ -46,6 +46,7 @@ from ..utils.fileio import atomic_write
 from .retry import retry_io
 
 LAST_GOOD_NAME = "LAST_GOOD"
+REJECTED_NAME = "REJECTED"
 SIDECAR_SUFFIX = ".sha256"
 
 _STEP_RE = re.compile(r"(\d+)\.npz")
@@ -234,6 +235,68 @@ def last_good_checkpoint(save_dir: str) -> Optional[str]:
             flush=True,
         )
     return None
+
+
+# ---------------------------------------------------------------------------
+# rejection ledger
+# ---------------------------------------------------------------------------
+
+
+def _rejected_path(save_dir: str) -> str:
+    return os.path.join(save_dir, REJECTED_NAME)
+
+
+def rejected_steps(save_dir: str) -> set:
+    """Steps the lifecycle controller has permanently rejected (failed
+    canary, vocab mismatch, shape drift).  A rejected step is never
+    re-canaried even if LAST_GOOD still points at it."""
+    steps = set()
+    try:
+        with open(_rejected_path(save_dir)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    steps.add(int(json.loads(line)["step"]))
+                except (ValueError, KeyError, TypeError):
+                    continue
+    except OSError:
+        pass
+    return steps
+
+
+def is_rejected(save_dir: str, step: int) -> bool:
+    return int(step) in rejected_steps(save_dir)
+
+
+def mark_rejected(save_dir: str, step: int, reason: str) -> bool:
+    """Append ``step`` to the rejection ledger (one JSON line per entry).
+    Exactly-once: returns False without writing when the step is already
+    in the ledger, so a rollback raced with a re-poll records a single
+    rejection.  Append (not atomic rewrite) keeps earlier entries intact
+    even if this write is torn — a torn tail line is skipped by the
+    reader."""
+    step = int(step)
+    if is_rejected(save_dir, step):
+        return False
+    record = json.dumps({"step": step, "reason": str(reason)}, sort_keys=True)
+    path = _rejected_path(save_dir)
+    # a torn tail from a crashed append has no newline: start fresh so
+    # this record parses instead of gluing onto the garbage
+    prefix = ""
+    try:
+        with open(path, "rb") as f:
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) != b"\n":
+                prefix = "\n"
+    except (OSError, ValueError):
+        pass
+    with open(path, "a") as f:
+        f.write(prefix + record + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return True
 
 
 # ---------------------------------------------------------------------------

@@ -69,6 +69,10 @@ class Request:
     result: Optional[Dict[str, Any]] = None
     error: Optional[Tuple[int, str]] = None
     bucket: Optional[int] = None
+    # which engine param slot serves this request ("incumbent" or
+    # "canary"); stamped by the lifecycle router at admission and honored
+    # by both dispatch disciplines
+    slot: str = "incumbent"
     # request-scoped tracing (telemetry.tracectx): stamped when the
     # gather loop pops this request; the trace rides along so the batcher
     # can attribute each phase to the originating X-Request-Id
@@ -124,6 +128,13 @@ class _BatcherBase:
         self._plan = faultinject.FaultPlan.from_env()
         self._draining = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # lifecycle control commands (arm_canary / swap / disarm_canary)
+        # execute ON the loop thread at the admission boundary — the same
+        # single-owner discipline as the continuous re-warm queue — so no
+        # batch ever straddles a param-slot flip
+        self._control_q: "queue.Queue[Tuple[str, Dict[str, Any], threading.Event]]" = (
+            queue.Queue()
+        )
 
     # -- admission (called from HTTP worker threads) -----------------------
 
@@ -132,6 +143,7 @@ class _BatcherBase:
         image: np.ndarray,
         deadline_unix: Optional[float] = None,
         trace: Optional[Any] = None,
+        slot: str = "incumbent",
     ) -> Request:
         """Admit one preprocessed image; raises Rejected(503) while
         draining and Rejected(429) when the queue is full."""
@@ -143,6 +155,7 @@ class _BatcherBase:
             t_submit_ns=time.perf_counter_ns(),
             deadline_unix=deadline_unix,
             trace=trace,
+            slot=slot,
         )
         try:
             self._q.put_nowait(req)
@@ -183,6 +196,54 @@ class _BatcherBase:
 
     def _loop(self) -> None:  # pragma: no cover - subclasses implement
         raise NotImplementedError
+
+    # -- lifecycle control (sat_tpu/lifecycle) -----------------------------
+
+    def lifecycle_control(self, action: str, timeout: float = 120.0) -> Dict[str, Any]:
+        """Run a lifecycle action (``arm_canary`` / ``swap`` /
+        ``disarm_canary``) on the loop thread between dispatches; inline
+        when the loop isn't running (tests, pre-start).  Returns the
+        action's result dict; raises on an action-level failure."""
+        box: Dict[str, Any] = {}
+        if self._thread is None or not self._thread.is_alive():
+            self._apply_control(action, box)
+        else:
+            ev = threading.Event()
+            self._control_q.put((action, box, ev))
+            if not ev.wait(timeout=timeout):
+                raise RuntimeError(f"lifecycle {action!r} timed out")
+        if "error" in box:
+            raise RuntimeError(box["error"])
+        return box
+
+    def _maybe_control(self) -> None:
+        while True:
+            try:
+                action, box, ev = self._control_q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                self._apply_control(action, box)
+            except Exception as e:  # report to the caller, keep serving
+                box["error"] = f"lifecycle {action!r} failed: {e}"
+            finally:
+                ev.set()
+
+    def _apply_control(self, action: str, box: Dict[str, Any]) -> None:
+        """Batch-mode semantics: dispatched batches captured their param
+        tree at dispatch time, so the swap is a pointer flip with no
+        drain to wait out; arm/disarm need no device state at all (the
+        canary slot is resolved per dispatch)."""
+        if action == "arm_canary":
+            box["ok"] = True
+        elif action == "swap":
+            t0 = time.monotonic()
+            box["step"] = self.engine.promote_candidate()
+            box["blackout_ms"] = (time.monotonic() - t0) * 1e3
+        elif action == "disarm_canary":
+            box["ok"] = True
+        else:
+            raise ValueError(f"unknown lifecycle action {action!r}")
 
     # -- wedge watchdog ----------------------------------------------------
 
@@ -257,6 +318,10 @@ class MicroBatcher(_BatcherBase):
             except queue.Empty:
                 if self._draining.is_set():
                     return None
+                if not self._control_q.empty():
+                    # wake the loop for a lifecycle command; [] is the
+                    # "nothing gathered, not draining" sentinel
+                    return []
         first.t_gather_ns = time.perf_counter_ns()
         batch = [first]
         flush_at = time.monotonic() + self.max_wait_s
@@ -295,10 +360,10 @@ class MicroBatcher(_BatcherBase):
                 live.append(r)
         return live
 
-    def _dispatch(self, live: List[Request]):
+    def _dispatch(self, live: List[Request], slot: str = "incumbent"):
         t0 = time.perf_counter_ns()
         batch, bucket = self.engine.pad_batch([r.image for r in live])
-        out = self.engine.dispatch(batch)
+        out = self.engine.dispatch(batch, slot=slot)
         t1 = time.perf_counter_ns()
         self._tel.record("serve/dispatch", t0, t1 - t0)
         self._tel.count("serve/batches")
@@ -310,7 +375,7 @@ class MicroBatcher(_BatcherBase):
         return out
 
     def _finish(self, entry) -> None:
-        out, live, index = entry
+        out, live, index, slot = entry
 
         def _drain():
             if self._plan.maybe_wedge_serve(index):
@@ -318,6 +383,7 @@ class MicroBatcher(_BatcherBase):
                 # device never answers (interruptible only by process exit)
                 time.sleep(3600.0)
             self._plan.maybe_slow_serve()
+            self._plan.maybe_slow_canary(slot)
             return self.engine.drain_output(out, len(live))
 
         try:
@@ -365,9 +431,32 @@ class MicroBatcher(_BatcherBase):
             r.done.set()
             self._tel.count("serve/completed")
 
+    def _dispatch_group(self, group: List[Request], slot: str, inflight) -> None:
+        try:
+            out = self._dispatch(group, slot)
+        except BucketOverflow as e:
+            # a burst past the largest warmed bucket is backpressure,
+            # not a server fault: shed with 429 + a Retry-After hint
+            # (the frontend adds the header)
+            self._tel.count("serve/shed_bucket_overflow")
+            for r in group:
+                r.fail(
+                    429,
+                    f"{e}; retry after the current batch drains",
+                )
+            return
+        except Exception as e:  # device/shape failure: fail the batch
+            self._tel.count("serve/dispatch_errors")
+            for r in group:
+                r.fail(500, f"dispatch failed: {e}")
+            return
+        self._batch_index += 1
+        inflight.append((out, group, self._batch_index, slot))
+
     def _loop(self) -> None:
         inflight: "deque" = deque()
         while True:
+            self._maybe_control()
             if inflight and self._q.qsize() == 0:
                 # Nothing to gather right now: flush the oldest in-flight
                 # batch instead of parking in _gather while its requesters
@@ -380,29 +469,19 @@ class MicroBatcher(_BatcherBase):
             self._tel.gauge("serve/queue_depth", self._q.qsize())
             if batch is None:
                 break
+            if not batch:  # woken for a lifecycle command
+                continue
             live = self._admit(batch)
             if not live:
                 continue
-            try:
-                out = self._dispatch(live)
-            except BucketOverflow as e:
-                # a burst past the largest warmed bucket is backpressure,
-                # not a server fault: shed with 429 + a Retry-After hint
-                # (the frontend adds the header)
-                self._tel.count("serve/shed_bucket_overflow")
-                for r in live:
-                    r.fail(
-                        429,
-                        f"{e}; retry after the current batch drains",
-                    )
-                continue
-            except Exception as e:  # device/shape failure: fail the batch
-                self._tel.count("serve/dispatch_errors")
-                for r in live:
-                    r.fail(500, f"dispatch failed: {e}")
-                continue
-            self._batch_index += 1
-            inflight.append((out, live, self._batch_index))
+            # one dispatch per param slot: a gathered batch mixing canary
+            # and incumbent requests splits, so each dispatch runs against
+            # exactly one param tree
+            groups: Dict[str, List[Request]] = {}
+            for r in live:
+                groups.setdefault(r.slot, []).append(r)
+            for slot in sorted(groups):
+                self._dispatch_group(groups[slot], slot, inflight)
             while len(inflight) > self.pipeline_depth:
                 self._finish(inflight.popleft())
         while inflight:  # drain: complete what the device still owes
@@ -453,6 +532,21 @@ class ContinuousBatcher(_BatcherBase):
         # re-warm requests are executed ON the loop thread (the pool is
         # single-owner; a concurrent warmup would race admission)
         self._rewarm_q: "queue.Queue[threading.Event]" = queue.Queue()
+        # lifecycle canary: a clone_warmed pool stepping the candidate
+        # params (zero extra compiles), present only during a canary
+        # window; requests that can't be seeded because their slot's pool
+        # is full wait here — held, never dropped
+        self._canary_pool = None
+        self._pending: List[Request] = []
+
+    def _pools(self) -> List[Any]:
+        pools = [self.pool]
+        if self._canary_pool is not None:
+            pools.append(self._canary_pool)
+        return pools
+
+    def _occupancy_total(self) -> int:
+        return sum(p.occupancy() for p in self._pools())
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -478,9 +572,11 @@ class ContinuousBatcher(_BatcherBase):
                 break
         return out
 
-    def _admit(self, reqs: List[Request]) -> None:
-        """Deadline triage + seed into free slots, marking per-request
-        admission phases.  Callers never pass more than free_count()."""
+    def _admit(self, reqs: List[Request], pool=None) -> None:
+        """Deadline triage + seed into free slots of ``pool`` (the main
+        pool by default), marking per-request admission phases.  Callers
+        never pass more than the pool's free_count()."""
+        pool = pool if pool is not None else self.pool
         now_ns = time.perf_counter_ns()
         now_unix = time.time()
         items = []
@@ -498,12 +594,12 @@ class ContinuousBatcher(_BatcherBase):
         if not items:
             return
         t0 = time.perf_counter_ns()
-        n = self.pool.admit(items)
+        n = pool.admit(items)
         t1 = time.perf_counter_ns()
         self._tel.count("serve/admitted", n)
         for _, r in items[:n]:
             # the page width is the continuous path's dispatch "bucket"
-            r.bucket = self.pool.width
+            r.bucket = pool.width
             r.t_admit_ns = t1
             r.mark("admit", t0, t1 - t0)
             # submit → seeded: the continuous path's admission latency
@@ -515,25 +611,65 @@ class ContinuousBatcher(_BatcherBase):
             r.fail(500, "slot pool admission overflow")
         self._tel.gauge("serve/queue_depth", self._q.qsize())
 
+    def _route_admissions(self) -> None:
+        """Route held + queued requests to their slot's pool, admitting
+        up to each pool's free capacity.  A request whose pool is full
+        stays in ``_pending`` (consumed first next iteration) — the
+        lifecycle plane must never drop or fail work just because the
+        canary pool is briefly saturated."""
+        pools = {"incumbent": self.pool}
+        if self._canary_pool is not None:
+            pools["canary"] = self._canary_pool
+        free = {k: p.free_count() for k, p in pools.items()}
+        headroom = sum(free.values()) - len(self._pending)
+        reqs = self._pending
+        if headroom > 0:
+            reqs = reqs + self._pop_queued(headroom)
+        self._pending = []
+        groups: Dict[str, List[Request]] = {k: [] for k in pools}
+        for r in reqs:
+            slot = r.slot if r.slot in pools else "incumbent"
+            if len(groups[slot]) < free[slot]:
+                groups[slot].append(r)
+            else:
+                self._pending.append(r)
+        for slot, group in groups.items():
+            if group:
+                self._admit(group, pools[slot])
+
     # -- the step loop -----------------------------------------------------
 
-    def _step_and_drain(self, index: int) -> np.ndarray:
+    def _step_pools(self, index: int) -> List[Tuple[Any, np.ndarray]]:
+        """One ``decode_step`` over every occupied pool (the canary pool
+        steps right after the incumbent when armed); returns
+        ``[(pool, done_flags)]``."""
         if self._plan.maybe_wedge_serve(index):
             # injected stuck step: park exactly like a drain whose device
             # never answers (interruptible only by process exit)
             time.sleep(3600.0)
         self._plan.maybe_slow_serve()
-        t0 = time.perf_counter_ns()
-        done_dev = self.pool.step()
-        done = np.asarray(done_dev)  # sync-ok: step boundary — the continuous loop's one bounded sync
-        self._tel.record("serve/step", t0, time.perf_counter_ns() - t0)
-        self._tel.count("serve/steps")
-        return done
+        out = []
+        for pool in self._pools():
+            if pool.occupancy() == 0:
+                continue
+            self._plan.maybe_slow_canary(pool.param_slot)
+            t0 = time.perf_counter_ns()
+            done_dev = pool.step()
+            done = np.asarray(done_dev)  # sync-ok: step boundary — the continuous loop's one bounded sync
+            self._tel.record("serve/step", t0, time.perf_counter_ns() - t0)
+            self._tel.count("serve/steps")
+            out.append((pool, done))
+        return out
 
     def _fail_inflight(self, status: int, reason: str) -> None:
-        for r in self.pool.inflight_payloads():
+        for pool in self._pools():
+            for r in pool.inflight_payloads():
+                if not r.done.is_set():
+                    r.fail(status, reason)
+        for r in self._pending:
             if not r.done.is_set():
                 r.fail(status, reason)
+        self._pending = []
 
     def _handle_wedge(self) -> None:
         # same counter the batch path trips, so /healthz consumers and
@@ -544,19 +680,21 @@ class ContinuousBatcher(_BatcherBase):
             "in-flight decode step wedged past "
             f"{self.wedge_timeout_s * 1e3:g}ms; slots discarded",
         )
-        try:
-            self.pool.reset()
-        except Exception:
-            pass  # a reset the device won't answer is the wedge itself
+        for pool in self._pools():
+            try:
+                pool.reset()
+            except Exception:
+                pass  # a reset the device won't answer is the wedge itself
         if self.on_wedge is not None:
             try:
                 self.on_wedge()
             except Exception:
                 pass  # degrading health must never kill the batcher
 
-    def _harvest(self, done: np.ndarray) -> None:
+    def _harvest(self, done: np.ndarray, pool=None) -> None:
+        pool = pool if pool is not None else self.pool
         t0 = time.perf_counter_ns()
-        payloads, words, lengths, scores, steps = self.pool.harvest(done)
+        payloads, words, lengths, scores, steps = pool.harvest(done)
         t1 = time.perf_counter_ns()
         for i, r in enumerate(payloads):
             r.mark("drain", t0, t1 - t0)
@@ -602,13 +740,18 @@ class ContinuousBatcher(_BatcherBase):
         self._fail_inflight(503, "server re-warming after wedge; retry")
         try:
             self.pool.warmup()
+            if self._canary_pool is not None:
+                # re-clone so the canary pool shares the freshly proven
+                # executables and starts from an empty carry too
+                self._canary_pool = self.pool.clone_warmed("canary")
         finally:
             ev.set()
 
     def _loop(self) -> None:
         while True:
             self._maybe_rewarm()
-            if self.pool.occupancy() == 0:
+            self._maybe_control()
+            if self._occupancy_total() == 0 and not self._pending:
                 # idle: park for the first arrival, polling the drain flag
                 try:
                     first = self._q.get(timeout=0.05)
@@ -616,43 +759,97 @@ class ContinuousBatcher(_BatcherBase):
                     if self._draining.is_set():
                         break
                     continue
-                self._admit([first])
-            # admit whatever else is queued RIGHT NOW into free slots —
-            # between steps, with no hold-open window
-            cap = self.pool.free_count()
-            if cap > 0:
-                riders = self._pop_queued(cap)
-                if riders:
-                    self._admit(riders)
-            if self.pool.occupancy() == 0:
+                self._pending.append(first)
+            # admit whatever is queued RIGHT NOW into each slot's free
+            # slots — between steps, with no hold-open window
+            self._route_admissions()
+            if self._occupancy_total() == 0:
                 continue  # everything admitted expired at the deadline gate
             self._step_index += 1
             index = self._step_index
             try:
                 if self.wedge_timeout_s > 0:
-                    done = self._bounded_decode(
-                        lambda: self._step_and_drain(index)
+                    dones = self._bounded_decode(
+                        lambda: self._step_pools(index)
                     )
                 else:
-                    done = self._step_and_drain(index)
+                    dones = self._step_pools(index)
             except _WedgeTimeout:
                 self._handle_wedge()
                 continue
             except Exception as e:  # keep serving; fail only in-flight work
                 self._tel.count("serve/dispatch_errors")
                 self._fail_inflight(500, f"decode step failed: {e}")
-                try:
-                    self.pool.reset()
-                except Exception:
-                    pass
+                for pool in self._pools():
+                    try:
+                        pool.reset()
+                    except Exception:
+                        pass
                 continue
-            if done.any():
-                self._harvest(done)
+            for pool, done in dones:
+                if done.any():
+                    self._harvest(done, pool)
         # drain: queue empty and pool empty — flush the detok worker
         self._detok_q.put(None)
         if self._detok_thread is not None:
             self._detok_thread.join(timeout=30.0)
             self._detok_thread = None
+
+    # -- lifecycle control (executed on the loop thread) -------------------
+
+    def _drain_step_bound(self, stop) -> bool:
+        """Step + harvest until ``stop()`` is satisfied, bounded by the
+        caption-length step budget so a done flag that never fires can't
+        wedge the loop forever.  Returns whether the drain completed."""
+        limit = 2 * self.pool.max_len + 8
+        for _ in range(limit):
+            if stop():
+                return True
+            self._step_index += 1
+            for pool, done in self._step_pools(self._step_index):
+                if done.any():
+                    self._harvest(done, pool)
+        return stop()
+
+    def _apply_control(self, action: str, box: Dict[str, Any]) -> None:
+        """Continuous-mode semantics: the decode carry is re-fed to every
+        step with whatever params the pool resolves NOW, so a swap must
+        wait out in-flight captions (they finish under the params they
+        started with).  That wait — during which nothing new is admitted
+        — IS the swap blackout window, bounded by the caption-length
+        step budget."""
+        if action == "arm_canary":
+            if self._canary_pool is None:
+                self._canary_pool = self.pool.clone_warmed("canary")
+            box["ok"] = True
+        elif action == "swap":
+            t0 = time.monotonic()
+            if not self._drain_step_bound(
+                lambda: self._occupancy_total() == 0
+            ):
+                self._fail_inflight(
+                    500, "lifecycle swap drain exceeded its step bound"
+                )
+            box["step"] = self.engine.promote_candidate()
+            self._canary_pool = None
+            box["blackout_ms"] = (time.monotonic() - t0) * 1e3
+        elif action == "disarm_canary":
+            pool = self._canary_pool
+            if pool is not None:
+                # rollback: in-flight canary captions complete normally
+                # (still against the candidate params — it is slow or
+                # diverging, not gone), then the pool is dropped
+                if not self._drain_step_bound(
+                    lambda: pool.occupancy() == 0
+                ):
+                    for r in pool.inflight_payloads():
+                        if not r.done.is_set():
+                            r.fail(503, "canary retired mid-decode; retry")
+                    pool.reset()
+                self._canary_pool = None
+            box["ok"] = True
+        else:
+            raise ValueError(f"unknown lifecycle action {action!r}")
 
     def rewarm(self) -> None:
         """The server's wedge-recovery hook: re-run the pool warmup

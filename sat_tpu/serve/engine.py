@@ -173,6 +173,95 @@ class ServeEngine:
         self.warm_compiles = 0
         self.warm_seconds = 0.0
         self.compiles_at_ready = 0
+        # second param slot for the lifecycle plane: a candidate tree with
+        # the same treedef/shapes/dtypes as the incumbent, runnable
+        # through the ALREADY-WARMED executables (params are runtime
+        # arguments to the AOT programs, so the swap is a pointer flip,
+        # never a compile).  None = no candidate staged.
+        self._candidate: Optional[Dict[str, Any]] = None
+
+    # -- param slots (lifecycle plane) ------------------------------------
+
+    def slot_variables(self, slot: str = "incumbent") -> Dict[str, Any]:
+        """The encode variables for ``slot``.  The canary slot falls back
+        to the incumbent when no candidate is staged — in-flight canary
+        work during a rollback completes against real params instead of
+        crashing."""
+        if slot == "canary" and self._candidate is not None:
+            return self._candidate["variables"]
+        return self._variables
+
+    def slot_decoder_params(self, slot: str = "incumbent"):
+        if slot == "canary" and self._candidate is not None:
+            return self._candidate["decoder_params"]
+        return self._decoder_params
+
+    @property
+    def candidate_step(self) -> Optional[int]:
+        return None if self._candidate is None else self._candidate["step"]
+
+    def install_candidate(
+        self, variables: Dict[str, Any], decoder_params, step: int,
+        source: str,
+    ) -> None:
+        """Stage a candidate param tree in the second slot.
+
+        The candidate MUST be executable by the incumbent's warmed
+        programs — same treedef, same leaf shapes and dtypes — or the
+        first canary dispatch would either recompile (jit path) or crash
+        (AOT path).  Verified here, before the candidate can see a
+        request; a mismatch raises ValueError and the caller rejects the
+        checkpoint's lineage entry."""
+        import jax
+
+        for name, have, want in (
+            ("variables", variables, self._variables),
+            ("decoder_params", decoder_params, self._decoder_params),
+        ):
+            have_leaves, have_def = jax.tree_util.tree_flatten(have)
+            want_leaves, want_def = jax.tree_util.tree_flatten(want)
+            if have_def != want_def:
+                raise ValueError(
+                    f"candidate {name} tree structure differs from the "
+                    f"incumbent ({source}): warmed executables cannot "
+                    "run it"
+                )
+            for h, w in zip(have_leaves, want_leaves):
+                if h.shape != w.shape or h.dtype != w.dtype:
+                    raise ValueError(
+                        f"candidate {name} leaf {h.shape}/{h.dtype} vs "
+                        f"incumbent {w.shape}/{w.dtype} ({source}): "
+                        "geometry drift, rejecting"
+                    )
+        self._candidate = {
+            "variables": variables,
+            "decoder_params": decoder_params,
+            "step": int(step),
+            "source": source,
+        }
+        self._tel.gauge("lifecycle/candidate_step", int(step))
+
+    def promote_candidate(self) -> int:
+        """Flip the active slot: the candidate becomes the incumbent and
+        the old incumbent's tree is dropped (its device buffers free once
+        in-flight work referencing them drains).  Callers sequence this at
+        the batcher's admission boundary so no batch straddles the flip.
+        Returns the new serving step."""
+        if self._candidate is None:
+            raise RuntimeError("no candidate staged to promote")
+        cand = self._candidate
+        self._candidate = None
+        self._variables = cand["variables"]
+        self._decoder_params = cand["decoder_params"]
+        self.step = cand["step"]
+        self._tel.gauge("lifecycle/candidate_step", -1)
+        return self.step
+
+    def clear_candidate(self) -> None:
+        """Drop a staged candidate (rollback): the incumbent is untouched
+        and the canary slot falls back to it for any stragglers."""
+        self._candidate = None
+        self._tel.gauge("lifecycle/candidate_step", -1)
 
     # -- startup -----------------------------------------------------------
 
@@ -258,16 +347,20 @@ class ServeEngine:
         Raises ValueError on undecodable bytes (frontend maps to 400)."""
         return self.loader.load_bytes(data)
 
-    def dispatch(self, images: np.ndarray):
+    def dispatch(self, images: np.ndarray, slot: str = "incumbent"):
         """Async: padded batch [bucket,S,S,3] → BeamResult of device
         arrays.  Calls the AOT executables directly, so the only work on
         this thread is argument transfer — the device runs ahead while the
-        host returns to batching (the ``device_prefetch`` overlap)."""
+        host returns to batching (the ``device_prefetch`` overlap).
+        ``slot`` selects which param tree the warmed executables run
+        against (incumbent or the staged canary candidate)."""
         import jax
 
+        variables = self.slot_variables(slot)
+        decoder_params = self.slot_decoder_params(slot)
         enc_exec, beam_exec = self._compiled[images.shape[0]]
         t0 = time.perf_counter_ns()
-        contexts = enc_exec(self._variables, jax.device_put(images))
+        contexts = enc_exec(variables, jax.device_put(images))
         if self._tel.enabled:
             # encode-lane timing (the serve/encode_ms introspection): only
             # with telemetry on do we wait out the encode before chaining
@@ -280,7 +373,7 @@ class ServeEngine:
                 t0,
                 time.perf_counter_ns() - t0,
             )
-        return beam_exec(self._decoder_params, contexts)
+        return beam_exec(decoder_params, contexts)
 
     def drain_output(self, out, n: int) -> Tuple[np.ndarray, ...]:
         """Drain the device result for the ``n`` live rows: host arrays

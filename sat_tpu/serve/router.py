@@ -321,6 +321,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
             )
             self._reply(status, payload, rid)
             return
+        if route in ("/reload", "/promote", "/rollback"):
+            params = urllib.parse.parse_qs(query)
+            name = (params.get("replica") or [""])[0]
+            status, payload = app.admin_lifecycle(route[1:], name or None)
+            self._reply(status, payload, rid)
+            return
         if route != "/caption":
             self._reply(404, {"error": f"no route {self.path}"}, rid)
             return
@@ -802,6 +808,63 @@ class Router:
         if replica:
             headers["X-Routed-Replica"] = replica
         return status, data, ctype, headers
+
+    # -- lifecycle admin fan-out --------------------------------------------
+
+    def admin_lifecycle(
+        self, action: str, name: Optional[str] = None
+    ) -> Tuple[int, Dict[str, Any]]:
+        """POST /reload | /promote | /rollback, optionally scoped with
+        ``?replica=<name>``: forward the verb to one replica or to every
+        routable one and aggregate.  200 when every targeted replica
+        answered 200; 502 otherwise (partial results included — a fleet
+        where only some replicas promoted needs operator eyes, not a
+        retry loop)."""
+        if name is not None:
+            if name not in self.endpoints:
+                return 404, {
+                    "error": f"unknown replica {name!r}",
+                    "replicas": sorted(self.endpoints),
+                }
+            targets = [name]
+        else:
+            targets = list(self.view()["routable"])
+            if not targets:
+                return 503, {"error": "no routable replicas"}
+        self._tel.count(f"route/lifecycle_{action}")
+        results: Dict[str, Dict[str, Any]] = {}
+        all_ok = True
+        for target in targets:
+            endpoint = self.endpoints[target]
+            # promote/rollback block on the replica until the verdict
+            # lands (canary drain + swap), so this hop outlives the
+            # replica's own decision timeout
+            conn = http.client.HTTPConnection(
+                endpoint.host, endpoint.port, timeout=240.0
+            )
+            try:
+                conn.request(
+                    "POST", f"/{action}", headers={"Content-Length": "0"}
+                )
+                resp = conn.getresponse()
+                raw = resp.read()
+                try:
+                    body = json.loads(raw)
+                except ValueError:
+                    body = {"raw": raw.decode("utf-8", "replace")}
+                results[target] = {"status": resp.status, "body": body}
+                if resp.status != 200:
+                    all_ok = False
+            except (OSError, http.client.HTTPException) as e:
+                results[target] = {"status": 0, "error": str(e)}
+                all_ok = False
+            finally:
+                conn.close()
+        return (200 if all_ok else 502), {
+            "action": action,
+            "replicas": results,
+            "ok": all_ok,
+        }
 
     # -- drain sequencing ---------------------------------------------------
 

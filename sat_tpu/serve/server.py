@@ -53,6 +53,8 @@ import numpy as np
 from .. import telemetry
 from ..config import Config
 from ..data.vocabulary import Vocabulary
+from ..lifecycle import LifecycleController
+from ..lifecycle import canary as canary_mod
 from ..resilience.preempt import GracefulShutdown
 from ..telemetry import promtext, tracectx
 from ..telemetry.heartbeat import Heartbeat
@@ -169,6 +171,10 @@ class _Handler(BaseHTTPRequestHandler):
         app = self.server.app
         rid = self._request_id()
         route, _, query = self.path.partition("?")
+        if route in ("/reload", "/promote", "/rollback"):
+            status, payload = app.admin_lifecycle(route[1:])
+            self._reply(status, payload, rid)
+            return
         if route == "/profile":
             import urllib.parse
 
@@ -308,6 +314,13 @@ class CaptionServer:
             fast_s=config.slo_window_fast_s,
             slow_s=config.slo_window_slow_s,
         )
+        # model-lifecycle plane (sat_tpu/lifecycle): always constructed
+        # so the admin endpoints (/reload /promote /rollback) work even
+        # without the background poller; the poller thread itself only
+        # starts when --model_reload > 0 (controller.start gates it)
+        self.lifecycle = LifecycleController(
+            config, engine, self.batcher, tel=self._tel
+        )
 
     @property
     def port(self) -> Optional[int]:
@@ -330,6 +343,7 @@ class CaptionServer:
         status: int,
         payload: Dict[str, Any],
         bucket: Optional[int] = None,
+        slot: str = canary_mod.INCUMBENT,
     ) -> Tuple[int, Dict[str, Any]]:
         """Every terminal /caption reply funnels through here: the access
         log gets its record, the SLO error-ratio counters tick, and the
@@ -340,6 +354,17 @@ class CaptionServer:
         self._tel.count("serve/http_requests")
         if status >= 500:
             self._tel.count("serve/http_5xx")
+        if slot == canary_mod.CANARY:
+            # the canary SLO engine scores ONLY canary-slot traffic: its
+            # own latency span and error-ratio counters, so a bad
+            # candidate burns its own objectives while the incumbent's
+            # serve-phase SLOs stay clean
+            self._tel.count("serve/canary_requests")
+            if status >= 500:
+                self._tel.count("serve/canary_5xx")
+            self._tel.record(
+                "serve/canary_request", trace.t_start_ns, total_ns
+            )
         self.tracer.finish(
             trace,
             status,
@@ -398,37 +423,60 @@ class CaptionServer:
         deadline_unix = (
             time.time() + budget_ms / 1e3 if budget_ms > 0 else None
         )
+        # lifecycle canary routing: a deterministic, sticky hash of the
+        # request id — outside a canary window every request is incumbent
+        slot = self.lifecycle.route(trace.trace_id)
         try:
             req = self.batcher.submit(
-                image, deadline_unix=deadline_unix, trace=trace
+                image, deadline_unix=deadline_unix, trace=trace, slot=slot
             )
         except Rejected as e:
             payload = {"error": e.reason}
             if e.status in (429, 503):
                 payload["retry_after_ms"] = self._retry_hint_ms()
-            return self._finish_request(trace, e.status, payload)
+            return self._finish_request(trace, e.status, payload, slot=slot)
         wait_s = (
             budget_ms / 1e3 + 5.0 if deadline_unix else self.DEFAULT_WAIT_S
         )
         if not req.done.wait(timeout=wait_s):
             self._tel.count("serve/timeouts")
             return self._finish_request(
-                trace, 504, {"error": "request timed out in service"}
+                trace, 504, {"error": "request timed out in service"},
+                slot=slot,
             )
         if req.error is not None:
             payload = {"error": req.error[1]}
             if req.error[0] in (429, 503):
                 payload["retry_after_ms"] = self._retry_hint_ms()
             return self._finish_request(
-                trace, req.error[0], payload, bucket=req.bucket
+                trace, req.error[0], payload, bucket=req.bucket, slot=slot
             )
         self._tel.record(
             "serve/request", t_req0, time.perf_counter_ns() - t_req0
         )
         payload = dict(req.result)
         payload["bucket"] = req.bucket
-        payload["model_step"] = self.engine.step
-        return self._finish_request(trace, 200, payload, bucket=req.bucket)
+        payload["slot"] = slot
+        if slot == canary_mod.CANARY:
+            step = self.engine.candidate_step
+            payload["model_step"] = (
+                step if step is not None else self.engine.step
+            )
+        else:
+            payload["model_step"] = self.engine.step
+            # shadow sampling: during a canary window, a sample of
+            # incumbent answers is replayed against the candidate to
+            # feed the caption-divergence gauge (bounded queue, never
+            # blocks this handler thread)
+            try:
+                self.lifecycle.maybe_shadow(
+                    image, payload["captions"][0]["caption"]
+                )
+            except (KeyError, IndexError, TypeError):
+                pass
+        return self._finish_request(
+            trace, 200, payload, bucket=req.bucket, slot=slot
+        )
 
     def _retry_hint_ms(self) -> int:
         """Retry-After hint for 429 sheds: about one service period — the
@@ -466,11 +514,37 @@ class CaptionServer:
                 "serve_mode": self.config.serve_mode,
                 "buckets": list(self.engine.buckets),
                 "model_step": self.engine.step,
+                # lifecycle plane: balancers and the fleet router see a
+                # canary in flight from the same cheap poll
+                "lifecycle_state": self.lifecycle.state,
             }
         )
+        candidate = self.engine.candidate_step
+        if candidate is not None:
+            payload["candidate_step"] = candidate
         if burning:
             payload["slo_burning"] = burning
         return payload, (200 if self._ready and not degraded else 503)
+
+    def admin_lifecycle(self, action: str) -> Tuple[int, Dict[str, Any]]:
+        """POST /reload | /promote | /rollback.  200 on success, 409 when
+        the machine is in the wrong state for the verb (no candidate to
+        promote, a cycle already in flight, a rejected/current step)."""
+        lc = self.lifecycle
+        if action == "reload":
+            ok, detail = lc.request_reload()
+        elif action == "promote":
+            ok, detail = lc.promote()
+        elif action == "rollback":
+            ok, detail = lc.rollback()
+        else:
+            return 404, {"error": f"no lifecycle action {action!r}"}
+        return (200 if ok else 409), {
+            "ok": ok,
+            "detail": detail,
+            "state": lc.state,
+            "model_step": self.engine.step,
+        }
 
     # -- wedge containment (called from the batcher thread) ----------------
 
@@ -544,6 +618,7 @@ class CaptionServer:
             "latency_ms": latency,
             "slo": self.slo.snapshot(),
             "profile_captures": self.profiles.captures,
+            "lifecycle": self.lifecycle.snapshot(),
         }
         # raw loop-iteration counts, not ms — how many decode steps each
         # request actually ran (continuous mode retires early; batch mode
@@ -675,6 +750,7 @@ class CaptionServer:
             self.slo.start(
                 interval_s=max(0.1, min(5.0, self.config.slo_window_fast_s / 4))
             )
+        self.lifecycle.start()
         self._ready = True
         self._tel.gauge("serve/ready", 1)
         return self
@@ -691,6 +767,10 @@ class CaptionServer:
             return
         self._ready = False
         self._tel.gauge("serve/ready", 0)
+        # stop the lifecycle plane before draining the batcher: an
+        # in-flight canary aborts (candidate cleared, ledger untouched —
+        # shutdown is not a verdict) so the drain sees only real work
+        self.lifecycle.stop()
         self.batcher.drain()
         self._httpd.shutdown()
         if self._http_thread is not None:

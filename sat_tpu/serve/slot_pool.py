@@ -61,10 +61,19 @@ class PagedSlotPool:
         pages: Optional[int] = None,
         page_width: Optional[int] = None,
         tel=None,
+        param_slot: str = "incumbent",
     ) -> None:
         config = engine.config
         self.engine = engine
         self.config = config
+        # which engine param slot this pool's dispatches run against; the
+        # lifecycle canary pool is a clone_warmed(param_slot="canary")
+        self.param_slot = param_slot
+        self._occ_gauge = (
+            "serve/slot_occupancy"
+            if param_slot == "incumbent"
+            else f"serve/slot_occupancy_{param_slot}"
+        )
         self.pages = int(
             pages if pages is not None else config.serve_slot_pages
         )
@@ -199,7 +208,29 @@ class PagedSlotPool:
         self._free = set(range(self.slots))
         self._payload.clear()
         self._mask[:] = False
-        self._tel.gauge("serve/slot_occupancy", 0)
+        self._tel.gauge(self._occ_gauge, 0)
+
+    def clone_warmed(self, param_slot: str) -> "PagedSlotPool":
+        """A second pool over the SAME warmed executables but a fresh
+        carry, dispatching against ``param_slot``.  The AOT programs take
+        the params as runtime arguments, so the canary pool costs zero
+        compiles — exactly the property the lifecycle zero-recompile
+        invariant needs.  Must be called after warmup()."""
+        if self._reset_exec is None:
+            raise RuntimeError("clone_warmed before warmup()")
+        clone = PagedSlotPool(
+            self.engine, pages=self.pages, page_width=self.width,
+            tel=self._tel, param_slot=param_slot,
+        )
+        clone._enc_execs = self._enc_execs
+        clone._seed_execs = self._seed_execs
+        clone._reset_exec = self._reset_exec
+        clone._step_exec = self._step_exec
+        clone._harvest_exec = self._harvest_exec
+        clone._retire_exec = self._retire_exec
+        clone.compiles_at_ready = self.compiles_at_ready
+        clone._carry = clone._reset_exec()
+        return clone
 
     # -- host bookkeeping --------------------------------------------------
 
@@ -249,7 +280,8 @@ class PagedSlotPool:
                 self._mask[s] = True
             t0 = time.perf_counter_ns()
             contexts = self._enc_execs[lane](
-                self.engine._variables, jax.device_put(images)
+                self.engine.slot_variables(self.param_slot),
+                jax.device_put(images),
             )
             if self._tel.enabled:
                 # per-lane encode timing (serve/encode_ms introspection):
@@ -261,13 +293,13 @@ class PagedSlotPool:
                 self._tel.record("serve/encode", t0, dur)
                 self._tel.record(f"serve/encode_lane{lane}", t0, dur)
             self._carry = self._seed_execs[lane](
-                self.engine._decoder_params,
+                self.engine.slot_decoder_params(self.param_slot),
                 self._carry,
                 contexts,
                 jax.device_put(slot_src),
                 jax.device_put(admit_mask),
             )
-        self._tel.gauge("serve/slot_occupancy", self.occupancy())
+        self._tel.gauge(self._occ_gauge, self.occupancy())
         return admitted
 
     def step(self):
@@ -277,7 +309,7 @@ class PagedSlotPool:
         import jax
 
         self._carry, done = self._step_exec(
-            self.engine._decoder_params,
+            self.engine.slot_decoder_params(self.param_slot),
             self._carry,
             jax.device_put(self._mask.copy()),
         )
@@ -309,5 +341,5 @@ class PagedSlotPool:
         self._carry = self._retire_exec(
             self._carry, jax.device_put(retire)
         )
-        self._tel.gauge("serve/slot_occupancy", self.occupancy())
+        self._tel.gauge(self._occ_gauge, self.occupancy())
         return payloads, words[ids], lengths[ids], scores[ids], steps[ids]

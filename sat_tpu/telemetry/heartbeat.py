@@ -184,6 +184,17 @@ class Heartbeat:
         }
         if slo:
             payload["slo"] = slo
+        # model-lifecycle plane (sat_tpu/lifecycle): state code, serving
+        # vs candidate step, canary divergence, last swap blackout — a
+        # watcher sees a canary in flight (state 3) and its verdict
+        # without hitting /stats
+        lc = {
+            k[len("lifecycle/"):]: v
+            for k, v in gauges.items()
+            if k.startswith("lifecycle/")
+        }
+        if lc:
+            payload["lifecycle"] = lc
         # fleet aggregate (telemetry.fleet): hosts reporting, step-p95
         # skew, straggler index — process 0's heartbeat answers "which
         # host is slow" without opening fleet.json

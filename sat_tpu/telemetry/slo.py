@@ -340,6 +340,41 @@ def objectives_from_config(config, phase: str) -> List[Objective]:
                     denom="serve/http_requests",
                 )
             )
+    elif phase == "canary":
+        # the lifecycle controller's qualification objectives: the same
+        # targets the serve plane declares, measured over CANARY-slot
+        # traffic only (the server records canary requests under their
+        # own span/counters), plus the caption-divergence ceiling that
+        # p99/error-rate cannot see.  Evaluated by a per-cycle engine
+        # whose windows are clipped to the canary window.
+        if config.slo_serve_p99_ms > 0:
+            out.append(
+                Objective(
+                    name="canary_p99_ms",
+                    kind="latency_p99",
+                    target=config.slo_serve_p99_ms,
+                    source="serve/canary_request",
+                )
+            )
+        if config.slo_error_ratio > 0:
+            out.append(
+                Objective(
+                    name="canary_error_ratio",
+                    kind="error_ratio",
+                    target=config.slo_error_ratio,
+                    source="serve/canary_5xx",
+                    denom="serve/canary_requests",
+                )
+            )
+        if config.canary_divergence_max > 0:
+            out.append(
+                Objective(
+                    name="canary_divergence",
+                    kind="gauge_ceiling",
+                    target=config.canary_divergence_max,
+                    source="lifecycle/caption_divergence",
+                )
+            )
     elif phase == "train":
         if config.slo_captions_per_s > 0:
             out.append(

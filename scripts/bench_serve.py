@@ -536,6 +536,169 @@ def fleet_bench(args, workdir) -> int:
     return 0 if all(v == 0 for v in recompiles.values()) else 1
 
 
+def _post_admin(port, action, timeout=240.0):
+    """POST a lifecycle admin verb; (status, payload).  Long timeout:
+    /promote blocks on the replica until the swap lands."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{action}", data=b"", method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def lifecycle_bench(args, workdir) -> int:
+    """--lifecycle: cost of a full reload -> canary -> promote cycle on
+    a live continuous-mode server.
+
+    Arm A is a steady open loop against the incumbent alone; a retrained
+    checkpoint then lands (sidecar + LAST_GOOD) and arm B runs the SAME
+    open loop mid-canary, so ``canary_overhead_pct`` is the p50 price of
+    dual-slot serving (hash routing + a second live slot pool).  The
+    operator promote that follows measures ``swap_blackout_ms`` — the
+    admission gap while in-flight pools drain before the param-slot
+    flip.  Exits nonzero on any steady-state recompile or any dropped
+    (5xx / connection-failed) request across the whole cycle: the
+    zero-downtime invariant IS the bench contract."""
+    from sat_tpu import telemetry
+    from sat_tpu.data.vocabulary import vocab_fingerprint
+    from sat_tpu.resilience import lineage
+    from sat_tpu.serve.engine import ServeEngine, load_serving_state
+    from sat_tpu.serve.server import CaptionServer
+
+    base_config, vocabulary, tel = _make_ckpt(args, workdir)
+    config = base_config.replace(
+        serve_mode="continuous",
+        serve_slot_pages=args.slot_pages,
+        serve_page_width=args.page_width,
+        model_reload=0.0,          # the bench drives /reload itself
+        canary_fraction=args.canary_fraction,
+        canary_window_s=600.0,     # never auto-expires under the bench
+        promote_policy="manual",   # the bench decides when to promote
+        canary_shadow_rate=0.0,
+    )
+    state, _ = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+    server = CaptionServer(config, engine, port=0).start()
+    try:
+        port = server.port
+        jpegs = _make_jpegs(8, config.image_size)
+        log(f"lifecycle server up on port {port} (slot pool "
+            f"{args.slot_pages}x{args.page_width}, canary fraction "
+            f"{args.canary_fraction})")
+        _post(port, jpegs[0])  # warm pass (first-touch host costs)
+        base_step = engine.step
+        compiles0 = tel.counters().get("jax/compiles", 0)
+
+        arm_a = open_loop(
+            port, jpegs, args.lifecycle_rate, args.lifecycle_requests
+        )
+        log(f"arm A (incumbent only) @ {args.lifecycle_rate}/s: "
+            f"{arm_a['ok']} ok, {arm_a['shed']} shed "
+            f"(p50 {arm_a['p50']}ms p99 {arm_a['p99']}ms)")
+
+        # a "retrain" lands: same geometry, nudged decoder params
+        new_step = base_step + 100
+        flat = dict(np.load(os.path.join(
+            config.save_dir, f"{base_step}.npz")))
+        for k in list(flat):
+            if k.startswith("params/decoder/") and flat[k].dtype.kind == "f":
+                flat[k] = flat[k] + np.asarray(1e-3, flat[k].dtype)
+        flat["global_step"] = np.asarray(new_step, np.int64)
+        cand_path = os.path.join(config.save_dir, f"{new_step}.npz")
+        with open(cand_path, "wb") as f:
+            np.savez(f, **flat)
+        lineage.write_sidecar(cand_path, vocab=vocab_fingerprint(
+            config.vocabulary_file, config.vocabulary_size))
+        lineage.mark_last_good(config.save_dir, new_step)
+
+        status, body = _post_admin(port, "reload")
+        if status != 200:
+            log(f"FAIL: /reload -> {status}: {body}")
+            return 1
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            if _get_json(port, "/stats")["lifecycle"]["state"] == "CANARY":
+                break
+            time.sleep(0.05)
+        else:
+            log("FAIL: canary never armed")
+            return 1
+        log(f"canary armed for step {new_step}")
+
+        arm_b = open_loop(
+            port, jpegs, args.lifecycle_rate, args.lifecycle_requests
+        )
+        log(f"arm B (mid-canary) @ {args.lifecycle_rate}/s: "
+            f"{arm_b['ok']} ok, {arm_b['shed']} shed "
+            f"(p50 {arm_b['p50']}ms p99 {arm_b['p99']}ms)")
+
+        status, body = _post_admin(port, "promote")
+        if status != 200 or body.get("model_step") != new_step:
+            log(f"FAIL: /promote -> {status}: {body}")
+            return 1
+        stats = _get_json(port, "/stats")
+        last = stats["lifecycle"].get("last_cycle") or {}
+        blackout_ms = last.get("blackout_ms")
+        # post-promote sanity: the new incumbent answers
+        post_status, _ = _post(port, jpegs[0])
+
+        recompiles = tel.counters().get("jax/compiles", 0) - compiles0
+        http_5xx = tel.counters().get("serve/http_5xx", 0)
+        errors = arm_a["errors"] + arm_b["errors"]
+        overhead_pct = (
+            round((arm_b["p50"] / arm_a["p50"] - 1.0) * 100.0, 2)
+            if arm_a["p50"] else None
+        )
+        log(f"promoted step {new_step}: swap blackout {blackout_ms}ms, "
+            f"canary p50 overhead {overhead_pct}%, steady-state "
+            f"recompiles {recompiles}, 5xx {http_5xx}")
+
+        common = {
+            "slot_pages": args.slot_pages,
+            "page_width": args.page_width,
+            "canary_fraction": args.canary_fraction,
+            "offered_rate_per_s": args.lifecycle_rate,
+            "requests_per_arm": args.lifecycle_requests,
+            "steady_state_compiles": recompiles,
+            "http_5xx": http_5xx,
+            **telemetry.bench_stamp(),
+        }
+        print(json.dumps({
+            "metric": "swap_blackout_ms",
+            "value": blackout_ms,
+            "unit": "ms",
+            "promoted_step": new_step,
+            "drain_mode": "continuous",
+            **common,
+        }), flush=True)
+        print(json.dumps({
+            "metric": "canary_overhead_pct",
+            "value": overhead_pct,
+            "unit": "pct",
+            "incumbent_p50_ms": arm_a["p50"],
+            "canary_p50_ms": arm_b["p50"],
+            "incumbent_p99_ms": arm_a["p99"],
+            "canary_p99_ms": arm_b["p99"],
+            **common,
+        }), flush=True)
+        ok = (
+            recompiles == 0 and http_5xx == 0 and errors == 0
+            and blackout_ms is not None and post_status == 200
+        )
+        if not ok:
+            log("FAIL: zero-downtime invariant violated "
+                f"(recompiles={recompiles}, 5xx={http_5xx}, "
+                f"errors={errors}, blackout={blackout_ms}, "
+                f"post_promote={post_status})")
+        return 0 if ok else 1
+    finally:
+        server.shutdown()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--concurrency", type=int, default=8)
@@ -593,14 +756,29 @@ def main() -> int:
                          "goodput scales with fleet size even when all "
                          "replicas share this host's CPUs; 0 disables "
                          "and measures raw CPU-decode contention")
+    ap.add_argument("--lifecycle", action="store_true",
+                    help="lifecycle mode: a full reload -> canary -> "
+                         "promote cycle on a live continuous-mode server "
+                         "(swap_blackout_ms / canary_overhead_pct rows; "
+                         "exit 1 on any recompile or dropped request)")
+    ap.add_argument("--lifecycle-rate", type=float, default=8.0,
+                    help="lifecycle mode: open-loop Poisson rate for the "
+                         "incumbent-only and mid-canary arms")
+    ap.add_argument("--lifecycle-requests", type=int, default=120,
+                    help="lifecycle mode: arrivals per arm")
+    ap.add_argument("--canary-fraction", type=float, default=0.25,
+                    help="lifecycle mode: request fraction hash-routed "
+                         "to the candidate during arm B")
     ap.add_argument("--workdir", default=None)
     args = ap.parse_args()
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="bench_serve_")
     made_workdir = args.workdir is None
-    if args.fleet:
+    if args.fleet or args.lifecycle:
         try:
-            return fleet_bench(args, workdir)
+            if args.fleet:
+                return fleet_bench(args, workdir)
+            return lifecycle_bench(args, workdir)
         finally:
             if made_workdir:
                 shutil.rmtree(workdir, ignore_errors=True)

@@ -989,6 +989,308 @@ def bulk_poison_quarantine(ctx: Ctx):
             "substituted_rows": len(quarantined_rows)}
 
 
+# The lifecycle rehearsals run in their own process (jax in a child):
+# a serve stack with the reloader armed, a retrained checkpoint landing
+# mid-traffic, and the full reload -> canary -> verdict cycle driven by
+# the REAL machinery — poller, hash router, SLO scorer, ledger.
+_LIFECYCLE_CHILD_PRELUDE = r'''
+import json, os, sys, threading, time, urllib.error, urllib.request
+
+import cv2
+import jax
+import numpy as np
+
+from sat_tpu import runtime, telemetry
+from sat_tpu.config import Config
+from sat_tpu.data.vocabulary import Vocabulary, vocab_fingerprint
+from sat_tpu.lifecycle import canary
+from sat_tpu.resilience import lineage
+from sat_tpu.serve.engine import ServeEngine, load_serving_state
+from sat_tpu.serve.server import CaptionServer
+from sat_tpu.train.checkpoint import save_checkpoint
+from sat_tpu.train.step import create_train_state
+
+workdir = sys.argv[1]
+vocab_file = os.path.join(workdir, "vocabulary.csv")
+vocabulary = Vocabulary(size=30)
+vocabulary.build(["a man riding a horse.", "a cat on a table."])
+vocabulary.save(vocab_file)
+
+
+def build_config(**kw):
+    return Config(
+        phase="serve", image_size=32, dim_embedding=16, num_lstm_units=16,
+        dim_initialize_layer=16, dim_attend_layer=16, dim_decode_layer=32,
+        compute_dtype="float32", vocabulary_size=vocabulary.size,
+        vocabulary_file=vocab_file, beam_size=2,
+        save_dir=os.path.join(workdir, "models"),
+        summary_dir=os.path.join(workdir, "summary"),
+        serve_queue_depth=64, heartbeat_interval=0.0, **kw,
+    )
+
+
+def boot(config):
+    os.makedirs(config.save_dir, exist_ok=True)
+    tel = telemetry.enable(capacity=16384)
+    runtime._install_compile_listener()
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    save_checkpoint(state, config)
+    lineage.mark_last_good(config.save_dir, int(np.asarray(state.step)))
+    state, _ = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+    server = CaptionServer(config, engine, port=0).start()
+    return tel, engine, server
+
+
+def stage_candidate(config, base_step, step, jitter=1e-3):
+    """A 'retrain' landing: the base params nudged, sidecar attested,
+    LAST_GOOD flipped — exactly what finalize_save publishes."""
+    flat = dict(np.load(os.path.join(config.save_dir, f"{base_step}.npz")))
+    for k in list(flat):
+        if k.startswith("params/decoder/") and flat[k].dtype.kind == "f":
+            flat[k] = flat[k] + np.asarray(jitter, flat[k].dtype)
+    flat["global_step"] = np.asarray(step, np.int64)
+    path = os.path.join(config.save_dir, f"{step}.npz")
+    with open(path, "wb") as f:
+        np.savez(f, **flat)
+    lineage.write_sidecar(path, vocab=vocab_fingerprint(
+        config.vocabulary_file, config.vocabulary_size))
+    lineage.mark_last_good(config.save_dir, step)
+
+
+img = np.random.default_rng(0).integers(0, 255, (32, 32, 3), dtype=np.uint8)
+ok, buf = cv2.imencode(".jpg", img)
+jpeg = bytes(buf)
+
+
+def post(port, rid, timeout=90.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/caption", data=jpeg, method="POST",
+        headers={"Content-Type": "image/jpeg", "X-Request-Id": rid})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def stats(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def wait_for(predicate, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+    raise AssertionError("timed out waiting for " + what)
+'''
+
+_LIFECYCLE_HOT_SWAP_CHILD = _LIFECYCLE_CHILD_PRELUDE + r'''
+# hot swap under load: the reloader notices the landed retrain, canaries
+# it, auto-promotes — while a generator hammers /caption the whole time.
+config = build_config(
+    serve_mode="continuous", serve_slot_pages=2, serve_page_width=2,
+    model_reload=0.3, canary_fraction=0.5, canary_window_s=2.0,
+    promote_policy="auto", canary_shadow_rate=0.0,
+)
+tel, engine, server = boot(config)
+port = server.port
+base_step = engine.step
+compiles0 = tel.counters().get("jax/compiles", 0)
+
+statuses, slots, steps = [], set(), set()
+stop = threading.Event()
+lock = threading.Lock()
+
+
+def generate(tag):
+    i = 0
+    while not stop.is_set():
+        status, payload = post(port, f"hs-{tag}-{i}")
+        with lock:
+            statuses.append(status)
+            if status == 200:
+                slots.add(payload["slot"])
+                steps.add(payload["model_step"])
+        i += 1
+
+
+threads = [threading.Thread(target=generate, args=(t,)) for t in "ab"]
+for t in threads:
+    t.start()
+time.sleep(0.5)  # steady incumbent traffic before the retrain lands
+stage_candidate(config, base_step, base_step + 100)
+wait_for(lambda: stats(port)["lifecycle"]["serving_step"] == base_step + 100,
+         90.0, "auto-promote of the landed retrain")
+time.sleep(0.5)  # post-promote traffic on the new incumbent
+stop.set()
+for t in threads:
+    t.join(timeout=120)
+
+s = stats(port)
+print(json.dumps({
+    "requests": len(statuses),
+    "non_200": sorted(set(x for x in statuses if x != 200)),
+    "slots": sorted(slots),
+    "steps": sorted(steps),
+    "served_step": s["lifecycle"]["serving_step"],
+    "last_cycle": s["lifecycle"].get("last_cycle"),
+    "compiles_since_ready": s["compiles_since_ready"],
+    "compile_delta": tel.counters().get("jax/compiles", 0) - compiles0,
+    "http_5xx": tel.counters().get("serve/http_5xx", 0),
+    "swap_blackout_ms": tel.gauges().get("lifecycle/swap_blackout_ms"),
+}))
+server.shutdown()
+'''
+
+_LIFECYCLE_ROLLBACK_CHILD = _LIFECYCLE_CHILD_PRELUDE + r'''
+# canary rollback: the candidate's batches run slowed (fault injection),
+# the canary p99 objective burns, the controller rolls back on its own
+# and the step lands in the rejection ledger — never re-canaried.
+config = build_config(
+    model_reload=0.3, canary_fraction=0.5, canary_window_s=30.0,
+    promote_policy="auto", canary_shadow_rate=0.0,
+    slo_serve_p99_ms=500.0,
+)
+tel, engine, server = boot(config)
+port = server.port
+base_step = engine.step
+compiles0 = tel.counters().get("jax/compiles", 0)
+bad_step = base_step + 100
+
+canary_ids = [f"cr-{i}" for i in range(200)
+              if canary.assign_slot(f"cr-{i}", 0.5) == canary.CANARY][:4]
+inc_ids = [f"cr-{i}" for i in range(200)
+           if canary.assign_slot(f"cr-{i}", 0.5) == canary.INCUMBENT][:2]
+
+status, payload = post(port, inc_ids[0])
+assert status == 200, status
+
+stage_candidate(config, base_step, bad_step)
+wait_for(lambda: stats(port)["lifecycle"]["state"] == "CANARY",
+         60.0, "canary to arm")
+
+# enough canary traffic to clear the SLO's MIN_EVENTS floor; each batch
+# runs ~2.5s slowed, blowing the 500ms p99 target
+threads = [threading.Thread(target=post, args=(port, rid))
+           for rid in canary_ids]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=120)
+wait_for(lambda: stats(port)["lifecycle"]["state"] == "IDLE",
+         90.0, "slo-burn rollback")
+
+s = stats(port)
+last = s["lifecycle"].get("last_cycle") or {}
+reloads_after_verdict = tel.counters().get("lifecycle/reloads", 0)
+# the poller keeps running against the unchanged (rejected) pointer:
+# give it several intervals to prove it never re-canaries the step
+time.sleep(1.2)
+s2 = stats(port)
+status, payload = post(port, inc_ids[1])
+
+ledger_path = os.path.join(config.save_dir, lineage.REJECTED_NAME)
+ledger_lines = [l for l in open(ledger_path).read().splitlines()
+                if l.strip()]
+print(json.dumps({
+    "last_cycle": last,
+    "rejected_steps": s["lifecycle"].get("rejected_steps", []),
+    "ledger_lines": len(ledger_lines),
+    "state_after_wait": s2["lifecycle"]["state"],
+    "reloads_total": tel.counters().get("lifecycle/reloads", 0),
+    "reloads_at_verdict": reloads_after_verdict,
+    "incumbent_status": status,
+    "incumbent_step": payload.get("model_step"),
+    "served_step": s2["lifecycle"]["serving_step"],
+    "compile_delta": tel.counters().get("jax/compiles", 0) - compiles0,
+    "http_5xx": tel.counters().get("serve/http_5xx", 0),
+}))
+server.shutdown()
+'''
+
+
+@scenario
+def lifecycle_hot_swap(ctx: Ctx):
+    """A retrained checkpoint lands (sidecar + LAST_GOOD) while load
+    generators hammer a continuous-mode server: the reloader canaries
+    it, auto-promotes after a clean window, and across the WHOLE cycle
+    there are zero non-200s and zero steady-state recompiles, with the
+    swap blackout measured."""
+    workdir = os.path.join(ctx.root, "lifecycle_hot_swap")
+    os.makedirs(workdir, exist_ok=True)
+    proc = subprocess.run(
+        [sys.executable, "-c", _LIFECYCLE_HOT_SWAP_CHILD, workdir],
+        capture_output=True, text=True, cwd=REPO,
+        env=_child_env(), timeout=_TIMEOUT,
+    )
+    check(proc.returncode == 0,
+          f"hot-swap child rc {proc.returncode}\n"
+          f"{proc.stdout}\n{proc.stderr}")
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    check(result["non_200"] == [],
+          f"requests dropped during the cycle: {result['non_200']} "
+          f"out of {result['requests']}")
+    check(result["http_5xx"] == 0, f"5xx counted: {result['http_5xx']}")
+    check(len(result["steps"]) == 2,
+          f"traffic should see exactly old+new steps: {result['steps']}")
+    check("canary" in result["slots"],
+          f"no request ever routed to the canary: {result['slots']}")
+    check((result["last_cycle"] or {}).get("outcome") == "promoted",
+          f"cycle did not promote: {result['last_cycle']}")
+    check(result["compiles_since_ready"] == 0
+          and result["compile_delta"] == 0,
+          f"hot swap recompiled: {result['compile_delta']} new compiles")
+    check(result["swap_blackout_ms"] is not None
+          and result["swap_blackout_ms"] >= 0,
+          "swap blackout never measured")
+    return {"requests": result["requests"],
+            "swap_blackout_ms": result["swap_blackout_ms"]}
+
+
+@scenario
+def lifecycle_canary_rollback(ctx: Ctx):
+    """SAT_FI_CANARY_SLOW_MS slows only candidate batches: the canary
+    p99 objective burns, the controller auto-rolls-back, the incumbent
+    never blips, and the rejected step lands in the lineage ledger
+    exactly once — the reloader never re-canaries it."""
+    workdir = os.path.join(ctx.root, "lifecycle_rollback")
+    os.makedirs(workdir, exist_ok=True)
+    proc = subprocess.run(
+        [sys.executable, "-c", _LIFECYCLE_ROLLBACK_CHILD, workdir],
+        capture_output=True, text=True, cwd=REPO,
+        env=_child_env({"SAT_FI_CANARY_SLOW_MS": "2500"}),
+        timeout=_TIMEOUT,
+    )
+    check(proc.returncode == 0,
+          f"rollback child rc {proc.returncode}\n"
+          f"{proc.stdout}\n{proc.stderr}")
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    last = result["last_cycle"] or {}
+    check(last.get("outcome") == "rolled_back",
+          f"cycle did not roll back: {last}")
+    check("slo burning" in last.get("why", ""),
+          f"rollback reason is not the burn: {last.get('why')!r}")
+    check(result["ledger_lines"] == 1,
+          f"rejection ledger has {result['ledger_lines']} lines, not 1")
+    check(result["state_after_wait"] == "IDLE"
+          and result["reloads_total"] == result["reloads_at_verdict"],
+          "reloader re-canaried a rejected step")
+    check(result["incumbent_status"] == 200
+          and result["incumbent_step"] == result["served_step"],
+          f"incumbent blipped: {result['incumbent_status']} "
+          f"step {result['incumbent_step']}")
+    check(result["http_5xx"] == 0, f"5xx counted: {result['http_5xx']}")
+    check(result["compile_delta"] == 0,
+          f"rollback recompiled: {result['compile_delta']}")
+    return {"ledger_lines": result["ledger_lines"],
+            "why": last.get("why", "")[:80]}
+
+
 # -- orchestration ----------------------------------------------------------
 
 

@@ -104,6 +104,11 @@ DEFAULT_MARGINS = {
     # decode) on a shared CPU host — wide margins like the fleet family
     "bulk_throughput_captions_s": 10.0,
     "bulk_resume_overhead_s": 25.0,
+    # lifecycle rows: the swap blackout is a continuous-mode pool drain
+    # timed on a shared CPU host, and canary overhead is a ratio of two
+    # open-loop p50s — both wall-clock-noisy families, wide margins
+    "swap_blackout_ms": 25.0,
+    "canary_overhead_pct": 25.0,
 }
 FALLBACK_MARGIN = 5.0
 

@@ -120,6 +120,19 @@ if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_bulk.json" ]; then
   FAILED="$FAILED bench_bulk"
 fi
 
+echo "=== stage 1i: lifecycle serve (hot-swap reload -> canary -> promote) ==="
+# a full zero-downtime reload cycle on the chip: candidate load + canary
+# routing under open-loop load, operator promote with the drain-measured
+# swap blackout; exits nonzero on any steady-state recompile or dropped
+# request across the cycle
+timeout 900 python scripts/bench_serve.py --lifecycle \
+  2>"$OUT/lifecycle_serve.log" | tee "$OUT/lifecycle_serve.json"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/lifecycle_serve.json" ]; then
+  echo "STAGE FAILED: lifecycle_serve (rc=$rc) — see $OUT/lifecycle_serve.log"
+  FAILED="$FAILED lifecycle_serve"
+fi
+
 echo "=== stage 2: pallas attention measurement ==="
 timeout 1800 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
 rc=${PIPESTATUS[0]}

@@ -304,8 +304,10 @@ def _sync_lint_targets():
     that must never touch a device value."""
     targets = [os.path.join(REPO, "sat_tpu", "runtime.py")]
     # bulk rides the serve drain discipline: its decode loop drains the
-    # slot-pool done flags whole-array, so it lints like serve does
-    for sub in ("serve", "resilience", "data", "bulk"):
+    # slot-pool done flags whole-array, so it lints like serve does;
+    # lifecycle's loader syncs once at candidate-staging time (declared)
+    # and its controller/reloader threads run beside the serve loop
+    for sub in ("serve", "resilience", "data", "bulk", "lifecycle"):
         sub_dir = os.path.join(REPO, "sat_tpu", sub)
         targets.extend(
             os.path.join(sub_dir, f)
@@ -389,6 +391,30 @@ def test_fleet_router_is_jax_free():
         "assert 'jax' not in sys.modules, 'router/replica pulled in jax'\n"
         "sat_tpu.serve.Rejected\n"
         "assert 'jax' in sys.modules, 'lazy engine-side export broken'\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=REPO, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_lifecycle_control_plane_is_jax_free():
+    """The model-lifecycle control plane (canary hash, reloader poll,
+    controller state machine) must import and run without jax: the
+    router forwards /reload-/promote-/rollback without owning a device
+    stack, and the reloader/ledger logic unit-tests on jax-free hosts.
+    Only the loader touches jax, and only inside load_candidate."""
+    code = (
+        "import sys\n"
+        "assert 'jax' not in sys.modules\n"
+        "import sat_tpu.lifecycle\n"
+        "from sat_tpu.lifecycle import canary, controller, loader, reloader\n"
+        "assert canary.assign_slot('req-1', 0.5) in ('incumbent', 'canary')\n"
+        "assert canary.caption_divergence('a b', 'a b') == 0.0\n"
+        "controller.STATE_CODES['CANARY']\n"
+        "assert 'jax' not in sys.modules, 'lifecycle control plane pulled in jax'\n"
     )
     proc = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,

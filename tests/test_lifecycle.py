@@ -1,0 +1,725 @@
+"""Model-lifecycle subsystem tests (docs/SERVING.md, lifecycle section).
+
+Pins the contracts the zero-downtime lifecycle ISSUE promises:
+
+* canary routing is a deterministic sticky hash (same id → same slot,
+  monotone in the fraction) and the divergence gauge is a bounded EWMA;
+* the rejection ledger is exactly-once and a rejected step is never
+  re-canaried (reloader skip + /reload 409);
+* the reloader fires once per distinct LAST_GOOD step and ignores
+  unchanged/current/rejected pointers;
+* the controller state machine: auto-promote at window end, manual
+  hold, operator promote/rollback, load-failure → ledger rejection,
+  SLO burn → rollback — all driven with stub engines/batchers (jax-free);
+* the loader fails fast on vocab-fingerprint mismatch and on partial
+  (geometry-drifted) checkpoints;
+* end-to-end over HTTP in BOTH serve modes: reload → canary → rollback
+  leaves the incumbent's answers bitwise identical, reload → canary →
+  promote switches captions — with ZERO recompiles and ZERO 5xx across
+  the full cycle, and the swap blackout measured.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sat_tpu import runtime, telemetry
+from sat_tpu.data.vocabulary import Vocabulary, vocab_fingerprint
+from sat_tpu.lifecycle import canary
+from sat_tpu.lifecycle.controller import (
+    STATE_CODES,
+    STATES,
+    LifecycleController,
+)
+from sat_tpu.lifecycle.reloader import Reloader
+from sat_tpu.resilience import lineage
+
+from tests.test_runtime import SMALL_MODEL
+
+
+# ---------------------------------------------------------------------------
+# canary routing hash + divergence (pure host math)
+# ---------------------------------------------------------------------------
+
+
+def test_assign_slot_deterministic_and_sticky():
+    ids = [f"req-{i}" for i in range(300)]
+    first = [canary.assign_slot(rid, 0.3) for rid in ids]
+    # sticky: the same id maps to the same slot every time
+    assert first == [canary.assign_slot(rid, 0.3) for rid in ids]
+    # both slots are actually used at an interior fraction
+    assert canary.CANARY in first and canary.INCUMBENT in first
+
+
+def test_assign_slot_monotone_in_fraction():
+    """A request canaried at fraction f stays canaried at any f' > f —
+    raising the fraction only ADDS traffic to the candidate, it never
+    flaps an already-canaried client back."""
+    for i in range(300):
+        rid = f"req-{i}"
+        if canary.assign_slot(rid, 0.2) == canary.CANARY:
+            assert canary.assign_slot(rid, 0.5) == canary.CANARY
+            assert canary.assign_slot(rid, 0.9) == canary.CANARY
+
+
+def test_assign_slot_edges():
+    assert canary.assign_slot("", 0.5) == canary.INCUMBENT
+    assert canary.assign_slot(None, 1.0) == canary.INCUMBENT
+    assert canary.assign_slot("abc", 0.0) == canary.INCUMBENT
+    assert canary.assign_slot("abc", -1.0) == canary.INCUMBENT
+    assert canary.assign_slot("abc", 1.0) == canary.CANARY
+
+
+def test_assign_slot_fraction_is_calibrated():
+    """The hash is uniform enough that the observed canary share tracks
+    the configured fraction."""
+    n = 4000
+    hits = sum(
+        canary.assign_slot(f"cal-{i}", 0.25) == canary.CANARY
+        for i in range(n)
+    )
+    assert abs(hits / n - 0.25) < 0.05
+
+
+def test_caption_divergence_jaccard():
+    assert canary.caption_divergence("a cat sat", "a cat sat") == 0.0
+    assert canary.caption_divergence("a b", "c d") == 1.0
+    assert canary.caption_divergence("", "") == 0.0
+    d = canary.caption_divergence("a cat on mat", "a dog on mat")
+    assert 0.0 < d < 1.0
+
+
+def test_divergence_gauge_ewma_bounded():
+    g = canary.DivergenceGauge(alpha=0.5)
+    assert g.value is None and g.samples == 0
+    assert g.update(1.0) == 1.0
+    assert g.update(0.0) == 0.5
+    assert g.samples == 2
+    # out-of-range inputs clamp instead of poisoning the EWMA
+    g.update(7.0)
+    assert 0.0 <= g.value <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# rejection ledger (resilience.lineage)
+# ---------------------------------------------------------------------------
+
+
+def test_rejection_ledger_exactly_once(tmp_path):
+    d = str(tmp_path)
+    assert lineage.rejected_steps(d) == set()
+    assert not lineage.is_rejected(d, 5)
+    assert lineage.mark_rejected(d, 5, "canary slo burning") is True
+    # second mark of the same step writes nothing (exactly-once)
+    assert lineage.mark_rejected(d, 5, "again") is False
+    assert lineage.rejected_steps(d) == {5}
+    assert lineage.is_rejected(d, 5)
+    assert not lineage.is_rejected(d, 6)
+    # the ledger file holds ONE line for step 5
+    lines = open(os.path.join(d, lineage.REJECTED_NAME)).read().splitlines()
+    assert len([l for l in lines if l.strip()]) == 1
+    assert json.loads(lines[0])["reason"] == "canary slo burning"
+
+
+def test_rejection_ledger_skips_torn_lines(tmp_path):
+    d = str(tmp_path)
+    lineage.mark_rejected(d, 3, "bad")
+    with open(os.path.join(d, lineage.REJECTED_NAME), "a") as f:
+        f.write('{"step": 9, "rea')  # torn tail from a crash mid-append
+    assert lineage.rejected_steps(d) == {3}
+    # a later full append still lands
+    assert lineage.mark_rejected(d, 9, "bad too") is True
+    assert lineage.rejected_steps(d) == {3, 9}
+
+
+# ---------------------------------------------------------------------------
+# reloader poll (unit: real lineage files, stub callback)
+# ---------------------------------------------------------------------------
+
+
+def _reloader(tmp_path, fired, current=None):
+    return Reloader(
+        str(tmp_path),
+        interval_s=0.05,
+        on_new=lambda step, path: fired.append((step, path)),
+        current_step=current,
+    )
+
+
+def test_reloader_fires_once_per_step(tmp_path):
+    fired = []
+    r = _reloader(tmp_path, fired)
+    assert r.poll_once() is None  # no pointer yet
+    lineage.mark_last_good(str(tmp_path), 7)
+    assert r.poll_once() == 7
+    assert fired == [(7, os.path.join(str(tmp_path), "7.npz"))]
+    # unchanged pointer: every later poll is a no-op
+    assert r.poll_once() is None
+    assert r.poll_once() is None
+    assert len(fired) == 1
+    # pointer moves → exactly one more fire
+    lineage.mark_last_good(str(tmp_path), 9)
+    assert r.poll_once() == 9
+    assert len(fired) == 2
+
+
+def test_reloader_skips_currently_served_step(tmp_path):
+    fired = []
+    r = _reloader(tmp_path, fired, current=lambda: 7)
+    lineage.mark_last_good(str(tmp_path), 7)
+    assert r.poll_once() is None
+    assert fired == []
+    # and it does not re-examine the same step forever
+    assert r.poll_once() is None
+
+
+def test_reloader_never_recanaries_rejected_step(tmp_path):
+    fired = []
+    r = _reloader(tmp_path, fired)
+    lineage.mark_rejected(str(tmp_path), 11, "failed canary")
+    lineage.mark_last_good(str(tmp_path), 11)
+    assert r.poll_once() is None
+    assert fired == []
+    # a NEW (un-rejected) step still fires
+    lineage.mark_last_good(str(tmp_path), 12)
+    assert r.poll_once() == 12
+    assert fired == [(12, os.path.join(str(tmp_path), "12.npz"))]
+
+
+def test_reloader_thread_polls_on_interval(tmp_path):
+    fired = []
+    r = _reloader(tmp_path, fired)
+    r.start()
+    try:
+        lineage.mark_last_good(str(tmp_path), 21)
+        deadline = time.time() + 5.0
+        while not fired and time.time() < deadline:
+            time.sleep(0.02)
+        assert fired == [(21, os.path.join(str(tmp_path), "21.npz"))]
+    finally:
+        r.stop()
+
+
+# ---------------------------------------------------------------------------
+# controller state machine (stub engine/batcher — jax-free)
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    def __init__(self, step=10):
+        self.step = step
+        self._cand = None
+        self.encoder_quant = "off"
+
+    @property
+    def candidate_step(self):
+        return self._cand
+
+    def install_candidate(self, variables, decoder_params, step, source):
+        self._cand = int(step)
+
+    def promote_candidate(self):
+        assert self._cand is not None
+        self.step, self._cand = self._cand, None
+        return self.step
+
+    def clear_candidate(self):
+        self._cand = None
+
+
+class _StubBatcher:
+    """Mimics the batcher control plane: ``swap`` promotes the engine
+    (the real ``_apply_control`` does) and reports a blackout."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.calls = []
+
+    def lifecycle_control(self, action, timeout=120.0):
+        self.calls.append(action)
+        if action == "swap":
+            return {
+                "step": self.engine.promote_candidate(),
+                "blackout_ms": 1.25,
+            }
+        return {"ok": True}
+
+    def submit(self, image, **kw):
+        raise RuntimeError("no shadow traffic in stub tests")
+
+
+def _controller(tmp_path, monkeypatch, cand_step=11, **cfg_kw):
+    from sat_tpu.config import Config
+    from sat_tpu.lifecycle import controller as controller_mod
+
+    base = dict(
+        save_dir=str(tmp_path),
+        canary_window_s=0.2,
+        promote_policy="auto",
+        canary_shadow_rate=0.0,
+        model_reload=0.0,
+    )
+    base.update(cfg_kw)
+    config = Config(**base)
+    eng = _StubEngine()
+    bat = _StubBatcher(eng)
+    monkeypatch.setattr(
+        controller_mod,
+        "load_candidate",
+        lambda engine, cfg, path: {
+            "variables": {},
+            "decoder_params": {},
+            "step": cand_step,
+            "source": path,
+        },
+    )
+    return LifecycleController(config, eng, bat), eng, bat
+
+
+def test_controller_auto_promotes_after_clean_window(tmp_path, monkeypatch):
+    ctl, eng, bat = _controller(tmp_path, monkeypatch)
+    assert ctl.state == "IDLE"
+    assert ctl.begin_cycle(11, "/ckpt/11.npz") is True
+    # a second cycle while one is in flight is refused, not queued
+    assert ctl.begin_cycle(12, "/ckpt/12.npz") is False
+    assert ctl._cycle_done.wait(timeout=30.0)
+    assert ctl.state == "IDLE"
+    assert eng.step == 11 and eng.candidate_step is None
+    assert bat.calls == ["arm_canary", "swap"]
+    last = ctl.snapshot()["last_cycle"]
+    assert last["outcome"] == "promoted" and last["step"] == 11
+    assert last["blackout_ms"] == 1.25
+    assert lineage.rejected_steps(str(tmp_path)) == set()
+
+
+def test_controller_manual_policy_holds_then_promotes(tmp_path, monkeypatch):
+    ctl, eng, bat = _controller(
+        tmp_path, monkeypatch, promote_policy="manual", canary_window_s=0.05
+    )
+    ctl.begin_cycle(11, "/ckpt/11.npz")
+    time.sleep(0.5)  # window long elapsed; manual policy must HOLD
+    assert ctl.state == "CANARY"
+    assert eng.step == 10
+    ok, detail = ctl.promote()
+    assert ok, detail
+    assert eng.step == 11 and ctl.state == "IDLE"
+    # nothing left to promote
+    ok, detail = ctl.promote()
+    assert not ok and "state=IDLE" in detail
+
+
+def test_controller_operator_rollback_rejects_exactly_once(
+    tmp_path, monkeypatch
+):
+    ctl, eng, bat = _controller(
+        tmp_path, monkeypatch, promote_policy="manual", canary_window_s=60.0
+    )
+    ctl.begin_cycle(11, "/ckpt/11.npz")
+    deadline = time.time() + 10.0
+    while ctl.state != "CANARY" and time.time() < deadline:
+        time.sleep(0.01)
+    ok, detail = ctl.rollback("operator said no")
+    assert ok, detail
+    assert ctl.state == "IDLE"
+    assert eng.step == 10 and eng.candidate_step is None
+    assert "disarm_canary" in bat.calls and "swap" not in bat.calls
+    assert lineage.rejected_steps(str(tmp_path)) == {11}
+    lines = open(
+        os.path.join(str(tmp_path), lineage.REJECTED_NAME)
+    ).read().splitlines()
+    assert len([l for l in lines if l.strip()]) == 1
+
+
+def test_controller_load_failure_lands_in_ledger(tmp_path, monkeypatch):
+    from sat_tpu.lifecycle import controller as controller_mod
+    from sat_tpu.train.checkpoint import VocabMismatchError
+
+    ctl, eng, bat = _controller(tmp_path, monkeypatch)
+
+    def boom(engine, cfg, path):
+        raise VocabMismatchError("vocab mismatch (got 30 words ...)")
+
+    monkeypatch.setattr(controller_mod, "load_candidate", boom)
+    ctl.begin_cycle(11, "/ckpt/11.npz")
+    assert ctl._cycle_done.wait(timeout=30.0)
+    assert ctl.state == "IDLE"
+    assert eng.step == 10
+    # the candidate never touched traffic: no arm, and the step is
+    # permanently rejected with the raising error recorded
+    assert "arm_canary" not in bat.calls
+    assert lineage.is_rejected(str(tmp_path), 11)
+    ledger = open(
+        os.path.join(str(tmp_path), lineage.REJECTED_NAME)
+    ).read()
+    assert "VocabMismatchError" in ledger
+
+
+def test_controller_slo_burn_rolls_back(tmp_path, monkeypatch):
+    tel = telemetry.enable(capacity=4096)
+    try:
+        ctl, eng, bat = _controller(
+            tmp_path,
+            monkeypatch,
+            canary_window_s=30.0,
+            canary_divergence_max=0.5,
+        )
+        ctl.begin_cycle(11, "/ckpt/11.npz")
+        deadline = time.time() + 10.0
+        while ctl.state != "CANARY" and time.time() < deadline:
+            time.sleep(0.01)
+        assert ctl.state == "CANARY"
+        # shadow-pair divergence crosses the ceiling: the gauge_ceiling
+        # objective burns instantly and the controller rolls back long
+        # before the 30 s window would have promoted
+        tel.gauge("lifecycle/caption_divergence", 0.9)
+        assert ctl._cycle_done.wait(timeout=30.0)
+        assert ctl.state == "IDLE"
+        assert eng.step == 10 and eng.candidate_step is None
+        assert lineage.is_rejected(str(tmp_path), 11)
+        last = ctl.snapshot()["last_cycle"]
+        assert last["outcome"] == "rolled_back"
+        assert "canary_divergence" in last["why"]
+    finally:
+        telemetry.disable()
+
+
+def test_controller_request_reload_guards(tmp_path, monkeypatch):
+    ctl, eng, bat = _controller(tmp_path, monkeypatch)
+    # no pointer at all
+    ok, detail = ctl.request_reload()
+    assert not ok and "LAST_GOOD" in detail
+    # pointer at the serving step
+    lineage.mark_last_good(str(tmp_path), 10)
+    ok, detail = ctl.request_reload()
+    assert not ok and "already serving" in detail
+    # pointer at a rejected step
+    lineage.mark_rejected(str(tmp_path), 15, "failed before")
+    lineage.mark_last_good(str(tmp_path), 15)
+    ok, detail = ctl.request_reload()
+    assert not ok and "rejection ledger" in detail
+
+
+def test_state_codes_cover_all_states():
+    assert set(STATE_CODES) == set(STATES)
+    assert STATE_CODES["IDLE"] == 0  # the gauge's resting value
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: train a tiny model, run real reload→canary→verdict cycles
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lifecycle_env(coco_fixture, tmp_path_factory):
+    """Tiny trained model + warmed engine + lifecycle-enabled config.
+
+    One engine serves every e2e test in this module (promotes mutate its
+    step — tests read ``engine.step`` at entry, never assume the trained
+    base)."""
+    from sat_tpu.serve.engine import ServeEngine, load_serving_state
+
+    root = tmp_path_factory.mktemp("lifecycle")
+    train_config = coco_fixture["config"].replace(
+        **SMALL_MODEL,
+        save_dir=os.path.join(str(root), "models"),
+        summary_dir=os.path.join(str(root), "summary"),
+    )
+    runtime.train(train_config)
+
+    config = train_config.replace(
+        phase="serve",
+        beam_size=2,
+        serve_buckets=(1, 4),
+        serve_max_batch=4,
+        serve_max_wait_ms=30.0,
+        serve_queue_depth=8,
+        heartbeat_interval=0.2,
+        # lifecycle: manual policy so the tests drive every verdict
+        # deterministically over HTTP; no background poller (POST /reload)
+        model_reload=0.0,
+        canary_fraction=0.5,
+        canary_window_s=60.0,
+        promote_policy="manual",
+        canary_shadow_rate=0.0,
+    )
+    tel = telemetry.enable(capacity=16384)
+    runtime._install_compile_listener()
+    vocabulary = Vocabulary(config.vocabulary_size, config.vocabulary_file)
+    state, source = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+    yield {
+        "config": config,
+        "engine": engine,
+        "tel": tel,
+        "base_step": engine.step,
+    }
+    telemetry.disable()
+
+
+def _stage_candidate(env, step, jitter=0.0, vocab=None):
+    """Write a geometry-identical candidate checkpoint (the trained
+    params, decoder floats nudged by ``jitter``) + sidecar, and point
+    LAST_GOOD at it."""
+    config = env["config"]
+    src = os.path.join(config.save_dir, f"{env['base_step']}.npz")
+    flat = dict(np.load(src))
+    if jitter:
+        for k in list(flat):
+            if k.startswith("params/decoder/") and flat[k].dtype.kind == "f":
+                flat[k] = (flat[k] + np.asarray(jitter, flat[k].dtype))
+    flat["global_step"] = np.asarray(step, np.int64)
+    path = os.path.join(config.save_dir, f"{step}.npz")
+    with open(path, "wb") as f:
+        np.savez(f, **flat)
+    if vocab is None:
+        vocab = vocab_fingerprint(
+            config.vocabulary_file, config.vocabulary_size
+        )
+    lineage.write_sidecar(path, vocab=vocab)
+    lineage.mark_last_good(config.save_dir, step)
+    return path
+
+
+def _jpeg(env):
+    d = env["config"].eval_image_dir
+    f = sorted(os.listdir(d))[0]
+    return open(os.path.join(d, f), "rb").read()
+
+
+def _http(port, method, path, body=None, headers=None, timeout=240):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=body,
+        method=method,
+        headers=headers or {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _caption(port, jpeg, rid):
+    return _http(
+        port, "POST", "/caption", body=jpeg,
+        headers={"Content-Type": "image/jpeg", "X-Request-Id": rid},
+    )
+
+
+def _admin(port, action):
+    return _http(port, "POST", f"/{action}", body=b"")
+
+
+def _wait_lifecycle_state(port, want, timeout=60.0):
+    deadline = time.time() + timeout
+    stats = {}
+    while time.time() < deadline:
+        _, stats = _http(port, "GET", "/stats")
+        if stats["lifecycle"]["state"] == want:
+            return stats
+        time.sleep(0.1)
+    raise AssertionError(
+        f"lifecycle never reached {want}: {stats.get('lifecycle')}"
+    )
+
+
+def _slot_ids(fraction, n_inc=2, n_can=1):
+    inc, can = [], []
+    i = 0
+    while len(inc) < n_inc or len(can) < n_can:
+        rid = f"lc-{i}"
+        i += 1
+        if canary.assign_slot(rid, fraction) == canary.CANARY:
+            can.append(rid)
+        else:
+            inc.append(rid)
+    return inc[:n_inc], can[:n_can]
+
+
+def test_e2e_continuous_reject_then_promote(lifecycle_env):
+    """The full invariant, continuous mode: reload → canary → rollback
+    leaves incumbent answers bitwise identical and the step rejected
+    exactly once (never re-canaried); reload → canary → promote switches
+    the served model — zero recompiles and zero 5xx across both cycles,
+    swap blackout measured."""
+    from sat_tpu.serve.server import CaptionServer
+
+    env = lifecycle_env
+    engine, tel = env["engine"], env["tel"]
+    config = env["config"].replace(
+        serve_mode="continuous", serve_slot_pages=2, serve_page_width=2
+    )
+    server = CaptionServer(config, engine, port=0).start()
+    try:
+        port = server.port
+        jpeg = _jpeg(env)
+        inc_ids, can_ids = _slot_ids(config.canary_fraction)
+        base_step = engine.step
+        compiles0 = tel.counters().get("jax/compiles", 0)
+
+        # baseline: everything incumbent while IDLE, canary-hash ids too
+        baseline = {}
+        for rid in inc_ids + can_ids:
+            status, p = _caption(port, jpeg, rid)
+            assert status == 200
+            assert p["slot"] == "incumbent"
+            assert p["model_step"] == base_step
+            baseline[rid] = p["captions"]
+
+        # ---- cycle 1: canary, then operator rollback --------------------
+        s_bad = env["base_step"] + 1000
+        _stage_candidate(env, s_bad, jitter=1e-3)
+        status, body = _admin(port, "reload")
+        assert status == 200, body
+        stats = _wait_lifecycle_state(port, "CANARY")
+        assert stats["lifecycle"]["candidate_step"] == s_bad
+        # healthz reports the canary from the cheap poll
+        _, hz = _http(port, "GET", "/healthz")
+        assert hz["lifecycle_state"] == "CANARY"
+        assert hz["candidate_step"] == s_bad
+
+        status, p = _caption(port, jpeg, can_ids[0])
+        assert status == 200
+        assert p["slot"] == "canary" and p["model_step"] == s_bad
+        status, p = _caption(port, jpeg, inc_ids[0])
+        assert status == 200
+        assert p["slot"] == "incumbent" and p["model_step"] == base_step
+
+        status, body = _admin(port, "rollback")
+        assert status == 200, body
+        assert body["state"] == "IDLE"
+        assert lineage.is_rejected(config.save_dir, s_bad)
+
+        # bitwise parity: the incumbent answers EXACTLY as before the
+        # rejected canary (same captions, same log probs)
+        for rid in inc_ids + can_ids:
+            status, p = _caption(port, jpeg, rid)
+            assert status == 200
+            assert p["model_step"] == base_step
+            assert p["captions"] == baseline[rid]
+
+        # the rejected step is never re-canaried
+        status, body = _admin(port, "reload")
+        assert status == 409, body
+        assert "rejection ledger" in body["detail"]
+        ledger = lineage.rejected_steps(config.save_dir)
+        assert s_bad in ledger
+
+        # ---- cycle 2: canary, then operator promote ---------------------
+        s_good = env["base_step"] + 2000
+        _stage_candidate(env, s_good, jitter=2e-3)
+        status, body = _admin(port, "reload")
+        assert status == 200, body
+        _wait_lifecycle_state(port, "CANARY")
+        status, body = _admin(port, "promote")
+        assert status == 200, body
+        assert body["model_step"] == s_good
+        status, p = _caption(port, jpeg, inc_ids[0])
+        assert status == 200 and p["model_step"] == s_good
+
+        # ---- the invariant ----------------------------------------------
+        _, stats = _http(port, "GET", "/stats")
+        assert stats["compiles_since_ready"] == 0
+        assert tel.counters().get("jax/compiles", 0) == compiles0
+        assert tel.counters().get("serve/http_5xx", 0) == 0
+        last = stats["lifecycle"]["last_cycle"]
+        assert last["outcome"] == "promoted" and last["step"] == s_good
+        assert last["blackout_ms"] >= 0.0
+        assert tel.gauges().get("lifecycle/swap_blackout_ms") is not None
+        assert stats["lifecycle"]["rejected_steps"] == [s_bad]
+        # exactly-once in the ledger file too
+        lines = open(
+            os.path.join(config.save_dir, lineage.REJECTED_NAME)
+        ).read().splitlines()
+        assert len([l for l in lines if l.strip()]) == 1
+    finally:
+        server.shutdown()
+
+
+def test_e2e_batch_mode_cycle_zero_recompiles(lifecycle_env):
+    """Batch mode rides the same machine: reload → canary request hits
+    the candidate through the SAME warmed executables (params are
+    runtime args), promote flips between dispatches — zero recompiles."""
+    from sat_tpu.serve.server import CaptionServer
+
+    env = lifecycle_env
+    engine, tel = env["engine"], env["tel"]
+    server = CaptionServer(env["config"], engine, port=0).start()
+    try:
+        port = server.port
+        jpeg = _jpeg(env)
+        inc_ids, can_ids = _slot_ids(env["config"].canary_fraction)
+        base_step = engine.step
+        compiles0 = tel.counters().get("jax/compiles", 0)
+
+        s_new = env["base_step"] + 3000
+        _stage_candidate(env, s_new, jitter=3e-3)
+        status, body = _admin(port, "reload")
+        assert status == 200, body
+        _wait_lifecycle_state(port, "CANARY")
+        status, p = _caption(port, jpeg, can_ids[0])
+        assert status == 200
+        assert p["slot"] == "canary" and p["model_step"] == s_new
+        status, p = _caption(port, jpeg, inc_ids[0])
+        assert status == 200
+        assert p["slot"] == "incumbent" and p["model_step"] == base_step
+
+        status, body = _admin(port, "promote")
+        assert status == 200, body
+        status, p = _caption(port, jpeg, inc_ids[0])
+        assert status == 200 and p["model_step"] == s_new
+
+        assert tel.counters().get("jax/compiles", 0) == compiles0
+        _, stats = _http(port, "GET", "/stats")
+        assert stats["compiles_since_ready"] == 0
+    finally:
+        server.shutdown()
+
+
+def test_loader_vocab_mismatch_fails_fast(lifecycle_env):
+    """A candidate attested against a different vocabulary raises
+    VocabMismatchError BEFORE any device memory is spent."""
+    from sat_tpu.lifecycle.loader import load_candidate
+    from sat_tpu.train.checkpoint import VocabMismatchError
+
+    env = lifecycle_env
+    step = env["base_step"] + 4000
+    path = _stage_candidate(
+        env, step, vocab={"sha256": "0" * 64, "size": 7}
+    )
+    with pytest.raises(VocabMismatchError):
+        load_candidate(env["engine"], env["config"], path)
+
+
+def test_loader_rejects_partial_checkpoint(lifecycle_env):
+    """Full-coverage placement: a checkpoint missing decoder tensors
+    (geometry drift / truncated write) is rejected, not half-loaded."""
+    from sat_tpu.lifecycle.loader import load_candidate
+
+    env = lifecycle_env
+    config = env["config"]
+    step = env["base_step"] + 5000
+    src = os.path.join(config.save_dir, f"{env['base_step']}.npz")
+    flat = dict(np.load(src))
+    dropped = [k for k in flat if k.startswith("params/decoder/")][0]
+    del flat[dropped]
+    flat["global_step"] = np.asarray(step, np.int64)
+    path = os.path.join(config.save_dir, f"{step}.npz")
+    with open(path, "wb") as f:
+        np.savez(f, **flat)
+    lineage.write_sidecar(
+        path,
+        vocab=vocab_fingerprint(
+            config.vocabulary_file, config.vocabulary_size
+        ),
+    )
+    with pytest.raises(ValueError, match="covers"):
+        load_candidate(env["engine"], env["config"], path)
