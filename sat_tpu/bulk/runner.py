@@ -155,10 +155,19 @@ def _decode_shard(
     step_counter: int,
 ) -> Tuple[List[Any], int]:
     """Run one assembled shard through the continuous stepped decode:
-    admit rows as slots free up, step the whole pool, harvest finished
-    beams early.  Returns per-row caption lists (row order) and the
-    advanced pool-step counter (the fault-injection clock —
-    ``SAT_FI_DIE_AT_STEP`` counts decode steps across shards)."""
+    admit rows as slots free up, run one fused ``decode_multi_step``
+    window over the whole pool, harvest finished beams early.  The
+    window depth rides the same queue-pressure policy as the serve loop
+    (``batcher.choose_decode_depth``): K=1 while corpus rows are still
+    waiting for a slot (a freed slot reseeds at the very next dispatch),
+    the deepest warmed lane once everything is submitted (the tail
+    amortizes one host round-trip over K device steps).  Returns per-row
+    caption lists (row order) and the advanced pool-step counter (the
+    fault-injection clock — ``SAT_FI_DIE_AT_STEP`` counts decode steps
+    across shards, so the counter advances by the steps actually run in
+    each window, keeping the chaos clock step-denominated)."""
+    from ..serve.batcher import choose_decode_depth
+
     n = batch.shape[0]
     results: List[Any] = [None] * n
     submitted = 0
@@ -173,12 +182,13 @@ def _decode_shard(
             items = [(batch[i], i) for i in range(submitted, submitted + take)]
             with wd.phase("dispatch"):
                 submitted += pool.admit(items)
+        k = choose_decode_depth(pool.decode_depths, n - submitted, 0)
         with wd.phase("dispatch"):
-            done = pool.step()
-        step_counter += 1
+            done, steps_dev = pool.multi_step(k)
         # whole [S] flag drain, decisions on the HOST — a device-side
         # reduction at varying occupancy would recompile (slot_pool rule)
         done_host = np.asarray(done)  # sync-ok: stepped-decode drain boundary, whole-array transfer
+        step_counter += int(np.asarray(steps_dev))  # sync-ok: same drain boundary as the done flags
         if done_host.any():
             payloads, words, lengths, scores, _steps = pool.harvest(done_host)
             if payloads:

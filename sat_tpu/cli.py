@@ -219,6 +219,17 @@ def build_config(argv: Optional[List[str]] = None):
              "beams early (docs/SERVING.md)",
     )
     p.add_argument(
+        "--serve_decode_depth", default=None, metavar="K1,K2,...",
+        help="serve phase (continuous): the fused decode window ladder — "
+             "comma-separated K values the adaptive policy may pick "
+             "(the depth is a runtime operand of one AOT-warmed "
+             "multi-step executable); the batcher runs the deepest K "
+             "when the admission queue is idle and K=1 under burst "
+             "(must start at 1; default "
+             "Config.serve_decode_depth=1,2,4,8; docs/SERVING.md 'Fused "
+             "decode window')",
+    )
+    p.add_argument(
         "--encoder_quant", choices=("off", "bf16", "int8"), default=None,
         help="serve phase: post-training quantization of the frozen CNN "
              "encoder at param load, before AOT warmup (docs/SERVING.md "
@@ -380,6 +391,10 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(serve_max_wait_ms=args.max_wait_ms)
     if args.serve_mode is not None:
         config = config.replace(serve_mode=args.serve_mode)
+    if args.serve_decode_depth is not None:
+        config = config.replace(serve_decode_depth=tuple(
+            int(k) for k in args.serve_decode_depth.split(",") if k
+        ))
     if args.encoder_quant is not None:
         config = config.replace(encoder_quant=args.encoder_quant)
     if args.model_reload is not None:

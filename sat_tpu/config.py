@@ -230,6 +230,15 @@ class Config:
     # fits before one init_slots gather seeds the free slots)
     serve_slot_pages: int = 4
     serve_page_width: int = 4
+    # fused decode window (continuous mode): the ladder of K values the
+    # adaptive policy may pick — a window runs up to K stepped decodes
+    # under ONE dispatch (lax.while_loop, on-device early-exit when the
+    # pool drains); the depth is a runtime operand, so one AOT-warmed
+    # executable serves the whole ladder.  The batcher picks a depth per
+    # tick from queue pressure: deepest K when the admission queue is
+    # empty, K=1 under burst so admission latency is preserved.  Must
+    # include 1 (the burst depth) and be strictly increasing.
+    serve_decode_depth: Tuple[int, ...] = (1, 2, 4, 8)
 
     # ---- model lifecycle (sat_tpu/lifecycle; docs/SERVING.md) ----
     # zero-downtime model refresh: a reloader thread polls the lineage
@@ -528,6 +537,21 @@ class Config:
             raise ValueError(
                 "Config.serve_slot_pages and serve_page_width must be >= 1"
             )
+        depths = tuple(self.serve_decode_depth)
+        if depths != self.serve_decode_depth:
+            # same hashability normalization as serve_buckets
+            object.__setattr__(self, "serve_decode_depth", depths)
+        if (
+            not depths
+            or depths[0] != 1
+            or any(int(k) <= 0 for k in depths)
+            or tuple(sorted(set(depths))) != depths
+        ):
+            raise ValueError(
+                f"Config.serve_decode_depth={self.serve_decode_depth}: must "
+                "be a strictly increasing tuple of positive step counts "
+                "starting at 1 (the burst lane)"
+            )
         if self.model_reload < 0:
             raise ValueError(
                 f"Config.model_reload={self.model_reload}: must be >= 0 "
@@ -645,7 +669,9 @@ class Config:
         kw = {k: v for k, v in raw.items() if k in names}
         # JSON has no tuples; these fields must come back hashable (the
         # Config rides jit static_argnames — a list field breaks lower())
-        for key in ("mesh_shape", "mesh_axes", "serve_buckets"):
+        for key in (
+            "mesh_shape", "mesh_axes", "serve_buckets", "serve_decode_depth"
+        ):
             if key in kw and isinstance(kw[key], list):
                 kw[key] = tuple(kw[key])
         return cls(**kw)

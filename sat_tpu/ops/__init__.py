@@ -3,6 +3,7 @@ from .beam_search import (
     SlotCarry,
     beam_search,
     beam_search_jit,
+    decode_multi_step,
     decode_step,
     greedy_decode,
     harvest_slots,
@@ -16,6 +17,7 @@ __all__ = [
     "SlotCarry",
     "beam_search",
     "beam_search_jit",
+    "decode_multi_step",
     "decode_step",
     "greedy_decode",
     "harvest_slots",

@@ -622,6 +622,56 @@ def decode_step(
     )
 
 
+def decode_multi_step(
+    params,
+    config: Config,
+    carry: SlotCarry,
+    slot_mask: jnp.ndarray,
+    eos_id: int,
+    k=1,
+    beam_size: Optional[int] = None,
+    valid_size: Optional[int] = None,
+) -> tuple:
+    """Advance the pool by up to ``k`` decode steps in ONE dispatch.
+
+    The inner loop is a ``lax.while_loop`` whose body is *exactly*
+    :func:`decode_step` — a slot that seals on inner iteration i drops
+    ``alive`` and is excluded from every later iteration by the same
+    ``slot_mask & alive`` gate the host-driven loop applies between
+    dispatches, so K fused steps are bitwise-identical to K sequential
+    ``decode_step`` dispatches (words, scores, alphas, per-slot ``t``).
+    Done detection moves on-device: the accumulated ``done`` mask names
+    every slot that sealed anywhere inside the window, and the host only
+    harvests.  The loop early-exits when nothing is left active, so a
+    pool that drains mid-window never burns the full K.
+
+    ``k`` is a dynamic operand — ``lax.while_loop`` takes a traced
+    bound, so ONE executable serves every ladder depth and the
+    zero-recompile guarantee across the ladder is structural, not a
+    warmed-lane-per-K inventory.  Returns ``(carry, done, steps_run)``
+    with ``steps_run`` the number of inner iterations actually executed
+    (``< k`` on early exit).
+    """
+    S = carry.t.shape[0]
+
+    def cond(loop):
+        i, c, _ = loop
+        return (i < k) & jnp.any(slot_mask & c.alive)
+
+    def body(loop):
+        i, c, done_acc = loop
+        c, done = decode_step(
+            params, config, c, slot_mask, eos_id,
+            beam_size=beam_size, valid_size=valid_size,
+        )
+        return (i + 1, c, done_acc | done)
+
+    steps_run, carry, done = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), carry, jnp.zeros((S,), jnp.bool_))
+    )
+    return carry, done, steps_run
+
+
 def retire_slots(carry: SlotCarry, retire_mask: jnp.ndarray) -> SlotCarry:
     """Mark slots dead after harvest (idempotent — ``decode_step`` already
     cleared ``alive`` for sealed slots; this also covers cancelling a

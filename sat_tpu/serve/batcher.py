@@ -48,6 +48,21 @@ class _WedgeTimeout(Exception):
     """An in-flight batch's result drain exceeded serve_wedge_timeout_ms."""
 
 
+def choose_decode_depth(
+    depths: Tuple[int, ...], queue_depth: int, pending: int
+) -> int:
+    """Adaptive fused-window policy (docs/SERVING.md "Fused decode
+    window"): with requests waiting to be seeded — anything queued or
+    held pending a free slot — run the shallow K=1 lane so admission
+    happens at the very next tick and submit→seeded latency is
+    preserved; with nothing waiting, run the deepest warmed lane so each
+    in-flight caption amortizes one host dispatch over K device steps.
+    Pure host arithmetic so the policy is unit-testable without a pool."""
+    if queue_depth > 0 or pending > 0:
+        return depths[0]
+    return depths[-1]
+
+
 class Rejected(Exception):
     """Admission refused; ``status`` is the HTTP code the frontend maps."""
 
@@ -495,9 +510,11 @@ class ContinuousBatcher(_BatcherBase):
 
     1. **admit** — pop whatever is queued (up to the pool's free slots),
        triage deadlines, seed a page per block of new requests;
-    2. **step** — one ``decode_step`` dispatch over the pool; draining
-       the [S] done flags is the loop's only host↔device sync, bounded
-       by the wedge watchdog;
+    2. **step** — one fused ``decode_multi_step`` dispatch over the pool
+       (up to K decode steps per dispatch, K chosen per tick from queue
+       pressure — :func:`choose_decode_depth`); draining the [S] done
+       flags is the loop's only host↔device sync, bounded by the wedge
+       watchdog;
     3. **harvest** — merge + drain finished slots, free them, and hand
        the host arrays to the detok worker thread (string work never
        blocks the step loop).
@@ -639,25 +656,43 @@ class ContinuousBatcher(_BatcherBase):
 
     # -- the step loop -----------------------------------------------------
 
+    def _choose_k(self) -> int:
+        return choose_decode_depth(
+            self.pool.decode_depths, self._q.qsize(), len(self._pending)
+        )
+
     def _step_pools(self, index: int) -> List[Tuple[Any, np.ndarray]]:
-        """One ``decode_step`` over every occupied pool (the canary pool
-        steps right after the incumbent when armed); returns
-        ``[(pool, done_flags)]``."""
+        """One fused ``decode_multi_step`` dispatch over every occupied
+        pool (the canary pool steps right after the incumbent when
+        armed); returns ``[(pool, done_flags)]``.  The window depth K is
+        chosen per tick from queue pressure (:func:`choose_decode_depth`)
+        and runs as one device dispatch; the on-device early exit means a
+        pool that seals mid-window reports ``steps_run < K``."""
         if self._plan.maybe_wedge_serve(index):
             # injected stuck step: park exactly like a drain whose device
             # never answers (interruptible only by process exit)
             time.sleep(3600.0)
         self._plan.maybe_slow_serve()
+        k = self._choose_k()
         out = []
         for pool in self._pools():
             if pool.occupancy() == 0:
                 continue
             self._plan.maybe_slow_canary(pool.param_slot)
             t0 = time.perf_counter_ns()
-            done_dev = pool.step()
+            done_dev, steps_dev = pool.multi_step(k)
             done = np.asarray(done_dev)  # sync-ok: step boundary — the continuous loop's one bounded sync
-            self._tel.record("serve/step", t0, time.perf_counter_ns() - t0)
-            self._tel.count("serve/steps")
+            steps_run = int(np.asarray(steps_dev))  # sync-ok: same dispatch as the done drain above
+            t1 = time.perf_counter_ns()
+            self._tel.record("serve/step", t0, t1 - t0)
+            # the chosen-K lane as its own named span: in Perfetto the
+            # serve/dispatch_k* tracks show dispatch amortization live
+            self._tel.record(f"serve/dispatch_k{k}", t0, t1 - t0)
+            # raw loop-iteration count (not ns) — < k when the pool
+            # sealed mid-window and the on-device early exit fired
+            self._tel.record("serve/steps_per_dispatch", 0, steps_run)
+            self._tel.count("serve/steps", steps_run)
+            self._tel.count("serve/dispatches")
             out.append((pool, done))
         return out
 
@@ -711,6 +746,14 @@ class ContinuousBatcher(_BatcherBase):
             if item is None:
                 return
             payloads, words, lengths, scores, t1 = item
+            # harvest → dequeue is detok-THREAD queueing, not string work:
+            # attribute it to its own span so serve/detok (and the
+            # per-request detok phase) measures pure detokenize cost — a
+            # deep fused window harvests in bursts, and folding the burst
+            # queueing into detok misattributes loop-side wins as
+            # host-side detok regressions
+            td = time.perf_counter_ns()
+            self._tel.record("serve/detok_queue", t1, td - t1)
             try:
                 results = self.engine.detok_rows(
                     (words, lengths, scores), len(payloads)
@@ -722,9 +765,10 @@ class ContinuousBatcher(_BatcherBase):
                         r.fail(500, f"detokenize failed: {e}")
                 continue
             t2 = time.perf_counter_ns()
-            self._tel.record("serve/detok", t1, t2 - t1)
+            self._tel.record("serve/detok", td, t2 - td)
             for r, result in zip(payloads, results):
-                r.mark("detok", t1, t2 - t1)
+                r.mark("detok_queue", t1, td - t1)
+                r.mark("detok", td, t2 - td)
                 r.result = result
                 r.done.set()
                 self._tel.count("serve/completed")

@@ -393,6 +393,11 @@ class ServeEngine:
             # percentiles and the bench divides by request count
             steps = int(np.asarray(out.steps_run))  # sync-ok: drained with the batch above
             self._tel.record("serve/decode_steps", 0, steps)
+            # the monolithic search is one dispatch running `steps` decode
+            # steps on-device — the whole-batch limit of the continuous
+            # path's fused window, reported on the same probe so both
+            # modes' dispatch amortization reads off one /stats block
+            self._tel.record("serve/steps_per_dispatch", 0, steps)
         return words, lengths, scores
 
     def detok_rows(

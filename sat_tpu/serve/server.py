@@ -70,6 +70,7 @@ _LATENCY_SPANS = (
     "serve/preprocess",
     "serve/dispatch",
     "serve/step",
+    "serve/detok_queue",
     "serve/detok",
 )
 
@@ -633,6 +634,12 @@ class CaptionServer:
             "encoder_quant": self.engine.encoder_quant,
             "quantize_seconds": round(self.engine.quantize_seconds, 3),
         }
+        # fused decode window: how many device steps each dispatch
+        # actually ran (the K ladder + on-device early exit live;
+        # docs/SERVING.md "Fused decode window")
+        spd = _percentiles_raw(self._tel, "serve/steps_per_dispatch")
+        if spd:
+            engine_block["steps_per_dispatch"] = spd
         enc = _percentiles_ms(self._tel, "serve/encode")
         if enc:
             engine_block["encode_ms"] = enc
@@ -671,6 +678,12 @@ class CaptionServer:
         if steps:
             self._tel.gauge("serve/decode_steps_p50", steps["p50"])
             self._tel.gauge("serve/decode_steps_p95", steps["p95"])
+        spd = _percentiles_raw(self._tel, "serve/steps_per_dispatch")
+        if spd:
+            # fused-window amortization: device steps per host dispatch
+            # (p50 tracks the chosen K ladder lane, p95 the deep lane)
+            self._tel.gauge("serve/steps_per_dispatch", spd["p50"])
+            self._tel.gauge("serve/steps_per_dispatch_p95", spd["p95"])
         enc = _percentiles_ms(self._tel, "serve/encode")
         if enc:
             # scrape-time refresh, same discipline as decode_steps: the

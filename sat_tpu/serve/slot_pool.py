@@ -30,7 +30,7 @@ import numpy as np
 # the ops package re-exports the beam_search FUNCTION, which shadows the
 # submodule on attribute import — import the names directly
 from ..ops.beam_search import (
-    decode_step,
+    decode_multi_step,
     harvest_slots,
     init_slot_pool,
     init_slots,
@@ -94,10 +94,17 @@ class PagedSlotPool:
         self._mask = np.zeros((self.slots,), np.bool_)
         self._carry = None
         self.lane_widths = _lane_widths(self.width)
+        # fused decode window (docs/SERVING.md): ONE multi-step
+        # executable per geometry — the window depth is a dynamic
+        # operand of the on-device while_loop, so every ladder depth
+        # rides the same program; decode_depths is the value set the
+        # adaptive policy may pick (config validation pins depths[0]==1,
+        # the burst depth)
+        self.decode_depths = tuple(config.serve_decode_depth)
         self._enc_execs = {}
         self._seed_execs = {}
+        self._multi_exec = None
         self._reset_exec = None
-        self._step_exec = None
         self._harvest_exec = None
         self._retire_exec = None
         self.warm_compiles = 0
@@ -157,14 +164,19 @@ class PagedSlotPool:
                 engine._decoder_params, config, carry_sd, ctx_sd,
                 src_sd, mask_sd, beam_size=K,
             ).compile()
-        self._step_exec = (
+        # ONE decode executable serves every depth: the fused window takes
+        # the depth as a runtime operand, so step() is just depth 1 of the
+        # same program — compiling a separate single-step lane would double
+        # the warmup cost for a body the window already contains
+        self._multi_exec = (
             jax.jit(
-                decode_step,
+                decode_multi_step,
                 static_argnames=("config", "eos_id", "beam_size", "valid_size"),
             )
             .lower(
                 engine._decoder_params, config, carry_sd, mask_sd,
-                self.eos_id, beam_size=K, valid_size=self.valid_size,
+                self.eos_id, jax.ShapeDtypeStruct((), np.int32),
+                beam_size=K, valid_size=self.valid_size,
             )
             .compile()
         )
@@ -194,7 +206,8 @@ class PagedSlotPool:
         self._tel.gauge("serve/pool_warm_seconds", round(self.warm_seconds, 3))
         print(
             f"sat_tpu: slot pool warmup — {self.pages}x{self.width} slots, "
-            f"lanes {self.lane_widths}, {self.warm_compiles} XLA compiles "
+            f"lanes {self.lane_widths}, decode depths "
+            f"{list(self.decode_depths)}, {self.warm_compiles} XLA compiles "
             f"in {self.warm_seconds:.1f}s (cached compiles are free)",
             file=sys.stderr,
             flush=True,
@@ -224,8 +237,8 @@ class PagedSlotPool:
         )
         clone._enc_execs = self._enc_execs
         clone._seed_execs = self._seed_execs
+        clone._multi_exec = self._multi_exec
         clone._reset_exec = self._reset_exec
-        clone._step_exec = self._step_exec
         clone._harvest_exec = self._harvest_exec
         clone._retire_exec = self._retire_exec
         clone.compiles_at_ready = self.compiles_at_ready
@@ -303,17 +316,43 @@ class PagedSlotPool:
         return admitted
 
     def step(self):
-        """One ``decode_step`` over the whole pool.  Returns the [S] done
-        flags STILL ON DEVICE — the caller owns the drain (and bounds it
-        with the wedge watchdog)."""
+        """One decode step over the whole pool — the fused window at
+        depth 1 (same executable, ``k`` is a runtime operand).  Returns
+        the [S] done flags STILL ON DEVICE — the caller owns the drain
+        (and bounds it with the wedge watchdog)."""
         import jax
 
-        self._carry, done = self._step_exec(
+        self._carry, done, _ = self._multi_exec(
             self.engine.slot_decoder_params(self.param_slot),
             self._carry,
             jax.device_put(self._mask.copy()),
+            jax.device_put(np.int32(1)),
         )
         return done
+
+    def multi_step(self, k: int):
+        """Up to ``k`` fused decode steps in ONE dispatch (the warmed
+        ``decode_multi_step`` executable; the depth is a runtime operand,
+        so every ladder value rides the same program).  Returns
+        ``(done, steps_run)`` STILL ON DEVICE: ``done`` [S] flags every
+        slot that sealed anywhere inside the window, ``steps_run`` the
+        inner iterations actually executed (< k when the pool drained
+        mid-window — the on-device early exit).  ``k`` must be a ladder
+        value (``decode_depths``) — the policy contract is the ladder,
+        and an off-ladder depth raises rather than silently widening it."""
+        import jax
+
+        if k not in self.decode_depths:
+            raise KeyError(
+                f"decode depth {k} not in ladder {list(self.decode_depths)}"
+            )
+        self._carry, done, steps_run = self._multi_exec(
+            self.engine.slot_decoder_params(self.param_slot),
+            self._carry,
+            jax.device_put(self._mask.copy()),
+            jax.device_put(np.int32(k)),
+        )
+        return done, steps_run
 
     def harvest(self, done: np.ndarray):
         """Drain and free the slots flagged in ``done`` (host bool [S]).

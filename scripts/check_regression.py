@@ -104,6 +104,12 @@ DEFAULT_MARGINS = {
     # decode) on a shared CPU host — wide margins like the fleet family
     "bulk_throughput_captions_s": 10.0,
     "bulk_resume_overhead_s": 25.0,
+    # fused-decode rows (docs/SERVING.md "Fused decode window"): the
+    # single-stream row is one closed-loop client on a shared CPU host —
+    # per-request wall clock, so moderately noisy; admission p95 rides
+    # the near-capacity open loop and inherits its burst jitter
+    "serve_single_stream_latency_ms": 15.0,
+    "serve_admission_latency_ms": 20.0,
     # lifecycle rows: the swap blackout is a continuous-mode pool drain
     # timed on a shared CPU host, and canary overhead is a ratio of two
     # open-loop p50s — both wall-clock-noisy families, wide margins
@@ -126,6 +132,8 @@ _LOWER_BETTER_EXACT = {
     "output_bytes",
     "argument_bytes",
     "serve_encode_ms",
+    "serve_single_stream_latency_ms",
+    "serve_admission_latency_ms",
     "quant_ctx_rel_err",
     "quant_logit_drift",
 }

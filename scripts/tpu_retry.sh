@@ -25,7 +25,7 @@ GAVE_UP=""
 # RETRY_STAGES / RETRY_STAGE_CMD / RETRY_PROBE_CMD exist so the
 # give-up/artifact bookkeeping is testable without a device
 # (tests/test_bench.py); production runs never set them.
-ORDER=${RETRY_STAGES:-"bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 bench_ce_bf16 bench_eval_ab bench_quant fleet_serve bench_bulk lifecycle_serve pallas pallas_serve profile bench_early_exit"}
+ORDER=${RETRY_STAGES:-"bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 bench_ce_bf16 bench_eval_ab fused_decode bench_quant fleet_serve bench_bulk lifecycle_serve pallas pallas_serve profile bench_early_exit"}
 
 stage_cmd() {
   if [ -n "${RETRY_STAGE_CMD:-}" ]; then echo "$RETRY_STAGE_CMD"; return; fi
@@ -42,6 +42,9 @@ stage_cmd() {
     # serve closed loop (which boots a second engine — hence ~2x the
     # bench_serve budget); both write JSONL rows to the one artifact
     bench_quant)          echo "timeout 2000 bash -c 'python scripts/bench_eval.py --batch 32 --encoder-quant int8 && python scripts/bench_serve.py --quant-ab int8'" ;;
+    # fused-decode K lanes on the real chip: bitwise parity vs stepped
+    # K=1, on-device early exit, ladder AOT warmup with zero recompiles
+    fused_decode)         echo "timeout 600 python -m pytest tests/test_continuous.py -q -k 'fused or multi_step or adaptive'" ;;
     # replica subprocess boots + 3 open-loop arms through the router
     fleet_serve)          echo "timeout 1200 python scripts/bench_serve.py --fleet" ;;
     # three CLI child runs (seed checkpoint, decode, resume)
@@ -68,6 +71,7 @@ artifact() {
   case "$1" in
     pallas)  echo "$OUT/pallas.txt" ;;
     pallas_serve) echo "$OUT/pallas_serve.txt" ;;
+    fused_decode) echo "$OUT/fused_decode.txt" ;;
     profile) echo "$OUT/profile_done.txt" ;;
     *)       echo "$OUT/$1.json" ;;
   esac

@@ -72,13 +72,29 @@ fi
 
 echo "=== stage 1e: serving smoke (AOT warmup + micro-batched load) ==="
 # boots the full serving stack on the chip: lineage load, per-bucket AOT
-# warmup, closed+open-loop load; exits nonzero if steady state recompiled
-timeout 600 python scripts/bench_serve.py \
+# warmup, closed+open-loop load, then the continuous arms — fused-ladder
+# single stream + near-capacity open loop and the K=1 A/B arm; exits
+# nonzero if ANY lane recompiled in steady state (budget covers the
+# extra continuous boot the K-ladder A/B adds)
+timeout 900 python scripts/bench_serve.py \
   2>"$OUT/bench_serve.log" | tee "$OUT/bench_serve.json"
 rc=${PIPESTATUS[0]}
 if [ "$rc" -ne 0 ] || [ ! -s "$OUT/bench_serve.json" ]; then
   echo "STAGE FAILED: bench_serve (rc=$rc) — see $OUT/bench_serve.log"
   FAILED="$FAILED bench_serve"
+fi
+
+echo "=== stage 1e2: fused decode window (K-lane parity on the chip) ==="
+# decode_multi_step's lax.while_loop through the REAL compiler: bitwise
+# K-lane parity vs stepped K=1, on-device early exit, and the ladder
+# warmup's zero-recompile contract (the CPU container only proves the
+# host side of these)
+timeout 600 python -m pytest tests/test_continuous.py -q \
+  -k "fused or multi_step or adaptive" 2>&1 | tee "$OUT/fused_decode.txt"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ]; then
+  echo "STAGE FAILED: fused_decode (rc=$rc) — see $OUT/fused_decode.txt"
+  FAILED="$FAILED fused_decode"
 fi
 
 echo "=== stage 1f: quantized-encoder A/B (int8 eval decode + serve closed loop) ==="

@@ -74,17 +74,25 @@ def _ops_setup(B=5, seed=0, **kw):
 
 def _stepped_decode_all(
     cfg, params, contexts, pages, width, *,
-    return_alphas=False, valid_size=None, admit_every=1,
+    return_alphas=False, valid_size=None, admit_every=1, k=1,
 ):
     """Run every request through a pages×width slot pool with staggered
-    admission (one new request every ``admit_every`` steps while slots
-    are free), harvesting/retiring the step each slot finishes.  Returns
-    per-request host BeamResults in submission order."""
+    admission (one new request every ``admit_every`` ticks while slots
+    are free), harvesting/retiring the tick each slot finishes.  ``k=1``
+    drives the pool with single ``decode_step`` dispatches (the fused
+    path's correctness baseline); ``k>1`` runs one fused
+    ``decode_multi_step`` window per tick, admissions landing only
+    between windows.  Returns per-request host BeamResults in
+    submission order."""
     B = contexts.shape[0]
     S = pages * width
     seed = jax.jit(bs.init_slots, static_argnames=("config", "beam_size"))
     step = jax.jit(
         bs.decode_step,
+        static_argnames=("config", "eos_id", "beam_size", "valid_size"),
+    )
+    multi = jax.jit(
+        bs.decode_multi_step,
         static_argnames=("config", "eos_id", "beam_size", "valid_size"),
     )
     harv = jax.jit(bs.harvest_slots, static_argnames=("return_alphas",))
@@ -117,10 +125,17 @@ def _stepped_decode_all(
         mask = np.zeros((S,), np.bool_)
         for s in binding:
             mask[s] = True
-        carry, done = step(
-            params, cfg, carry, jnp.asarray(mask), EOS,
-            valid_size=valid_size,
-        )
+        if k == 1:
+            carry, done = step(
+                params, cfg, carry, jnp.asarray(mask), EOS,
+                valid_size=valid_size,
+            )
+        else:
+            carry, done, steps_run = multi(
+                params, cfg, carry, jnp.asarray(mask), EOS,
+                jnp.int32(k), valid_size=valid_size,
+            )
+            assert int(np.asarray(steps_run)) <= k
         done = np.asarray(done)
         if done.any():
             out = harv(carry, return_alphas=return_alphas)
@@ -203,6 +218,133 @@ def test_stepped_per_slot_steps_reflect_early_exit():
     # the pool runs each slot exactly as long as the monolithic whole-
     # batch early exit would have run its slowest member
     assert max(steps) == int(np.asarray(mono.steps_run))
+
+
+# ---------------------------------------------------------------------------
+# Fused decode window (decode_multi_step) — ISSUE 16
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_fused_window_bitwise_parity_staggered(k):
+    """K fused steps per dispatch vs K=1 stepped decode under staggered
+    admission: words, scores, lengths, alphas AND per-slot step counts
+    bitwise-equal — the fused while_loop body IS decode_step, and a slot
+    frozen mid-window stays frozen exactly as it would between host
+    dispatches."""
+    cfg, params, contexts = _ops_setup(B=5)
+    base = _stepped_decode_all(
+        cfg, params, contexts, pages=2, width=2, return_alphas=True, k=1,
+    )
+    fused = _stepped_decode_all(
+        cfg, params, contexts, pages=2, width=2, return_alphas=True, k=k,
+    )
+    for i, (want, got) in enumerate(zip(base, fused)):
+        assert np.array_equal(want.words, got.words), (k, i)
+        assert np.array_equal(want.log_scores, got.log_scores), (k, i)
+        assert np.array_equal(want.lengths, got.lengths), (k, i)
+        assert np.array_equal(want.alphas, got.alphas), (k, i)
+        assert int(want.steps_run) == int(got.steps_run), (k, i)
+    # and both match the monolithic oracle (transitivity made explicit)
+    mono = bs.beam_search(params, cfg, contexts, EOS, return_alphas=True)
+    for i, got in enumerate(fused):
+        assert np.array_equal(np.asarray(mono.words)[i], got.words), (k, i)
+        assert np.array_equal(
+            np.asarray(mono.alphas)[i], got.alphas
+        ), (k, i)
+
+
+@pytest.mark.parametrize("valid_size", [None, 25])
+def test_fused_window_bitwise_parity_bursty(valid_size):
+    """Bursty admission (every 3 ticks) through degenerate geometries
+    with a deep window: still bitwise vs the K=1 baseline, valid_size
+    masking included."""
+    cfg, params, contexts = _ops_setup(B=3, seed=7)
+    for pages, width in ((1, 1), (1, 2)):
+        base = _stepped_decode_all(
+            cfg, params, contexts, pages=pages, width=width,
+            admit_every=3, valid_size=valid_size, k=1,
+        )
+        fused = _stepped_decode_all(
+            cfg, params, contexts, pages=pages, width=width,
+            admit_every=3, valid_size=valid_size, k=4,
+        )
+        for i, (want, got) in enumerate(zip(base, fused)):
+            assert np.array_equal(want.words, got.words), (pages, width, i)
+            assert np.array_equal(
+                want.log_scores, got.log_scores
+            ), (pages, width, i)
+            assert int(want.steps_run) == int(got.steps_run), (
+                pages, width, i,
+            )
+
+
+def test_fused_window_on_device_early_exit():
+    """A pool that seals mid-window stops iterating ON DEVICE: steps_run
+    comes back < k, and a fully inactive pool runs zero steps."""
+    cfg, params, contexts = _ops_setup(B=1)
+    mono = bs.beam_search_jit(
+        params, cfg, contexts, EOS,
+        beam_size=cfg.beam_size, return_steps=True,
+    )
+    n = int(np.asarray(mono.steps_run))
+    S = 2
+    carry = bs.init_slot_pool(cfg, slots=S)
+    slot_src = np.zeros((S,), np.int32)
+    admit = np.zeros((S,), np.bool_)
+    admit[0] = True
+    carry = bs.init_slots(
+        params, cfg, carry, contexts[0][None],
+        jnp.asarray(slot_src), jnp.asarray(admit),
+    )
+    mask = np.zeros((S,), np.bool_)
+    mask[0] = True
+    carry, done, steps_run = bs.decode_multi_step(
+        params, cfg, carry, jnp.asarray(mask), EOS, k=n + 4,
+    )
+    # the slot seals after exactly its monolithic step count and the
+    # while_loop exits the moment nothing is active — never burning the
+    # remaining window
+    assert int(np.asarray(steps_run)) == n < n + 4
+    done = np.asarray(done)
+    assert done[0] and not done[1]
+    # drained pool (the harvested slot's mask dropped): zero iterations
+    carry, done2, steps2 = bs.decode_multi_step(
+        params, cfg, carry, jnp.zeros((S,), jnp.bool_), EOS, k=4,
+    )
+    assert int(np.asarray(steps2)) == 0
+    assert not np.asarray(done2).any()
+
+
+def test_adaptive_k_policy_units():
+    """Queue pressure forces the shallow lane; an idle queue runs deep."""
+    from sat_tpu.serve.batcher import choose_decode_depth
+
+    depths = (1, 2, 4, 8)
+    assert choose_decode_depth(depths, 0, 0) == 8    # idle -> deepest
+    assert choose_decode_depth(depths, 1, 0) == 1    # queued burst
+    assert choose_decode_depth(depths, 7, 3) == 1    # both
+    assert choose_decode_depth(depths, 0, 2) == 1    # held pending
+    assert choose_decode_depth((1,), 0, 0) == 1      # ladder of one
+    assert choose_decode_depth((1, 4), 0, 0) == 4
+
+
+def test_serve_decode_depth_config_validation():
+    cfg = tiny_config()
+    assert cfg.serve_decode_depth == (1, 2, 4, 8)
+    # list arrivals normalize to a hashable tuple (jit static arg rule)
+    assert cfg.replace(
+        serve_decode_depth=[1, 3]
+    ).serve_decode_depth == (1, 3)
+    for bad in ((), (2, 4), (1, 4, 2), (1, 1, 2), (1, 0)):
+        with pytest.raises(ValueError):
+            cfg.replace(serve_decode_depth=bad)
+    # JSON round-trip restores the tuple
+    from sat_tpu.config import Config
+
+    assert Config.from_dict(
+        {"serve_decode_depth": [1, 2]}
+    ).serve_decode_depth == (1, 2)
 
 
 @pytest.mark.parametrize(
@@ -354,6 +496,36 @@ def test_slot_pool_bookkeeping_and_zero_recompile_reuse(served):
     assert tel.counters().get("jax/compiles", 0) == compiles0
     pool.reset()
     assert pool.occupancy() == 0 and pool.inflight_payloads() == []
+
+
+def test_multi_step_all_lanes_zero_recompile(served):
+    """Every ladder depth steps the pool without a single XLA compile
+    (the depth is a runtime operand of ONE warmed executable), and an
+    off-ladder depth raises instead of silently widening the policy."""
+    engine, tel = served["engine"], served["tel"]
+    pool = _make_pool(served, pages=1, page_width=2)
+    assert pool.decode_depths == (1, 2, 4, 8)
+    img = _zero_image(engine)
+    compiles0 = tel.counters().get("jax/compiles", 0)
+    for k in pool.decode_depths:
+        assert pool.admit([(img, f"lane{k}")]) == 1
+        guard = 0
+        while pool.occupancy():
+            done, steps_dev = pool.multi_step(k)
+            done = np.asarray(done)  # sync-ok: test drain
+            steps = int(np.asarray(steps_dev))  # sync-ok: test drain
+            assert 1 <= steps <= k
+            if done.any():
+                pool.harvest(done)
+            guard += 1
+            assert guard <= 2 * engine.config.max_caption_length
+    assert tel.counters().get("jax/compiles", 0) == compiles0
+    with pytest.raises(KeyError):
+        pool.multi_step(3)
+    # the lifecycle clone shares the fused executable (zero compiles there)
+    clone = pool.clone_warmed("canary")
+    assert clone._multi_exec is pool._multi_exec
+    assert tel.counters().get("jax/compiles", 0) == compiles0
 
 
 def test_continuous_batcher_admits_beyond_capacity_and_drains(served):
@@ -513,12 +685,27 @@ def test_e2e_continuous_parity_stats_zero_recompiles(served):
         assert "serve/step" in stats["latency_ms"]
         assert stats["counters"].get("serve/admitted", 0) >= 8
 
+        # fused decode window observability: device steps per dispatch
+        # in the engine block, bounded by the warmed ladder
+        spd = stats["engine"]["steps_per_dispatch"]
+        assert 1 <= spd["p50"] <= spd["p95"]
+        assert spd["p95"] <= max(config.serve_decode_depth)
+        assert stats["counters"].get("serve/dispatches", 0) >= 1
+        # dispatch amortization: total steps never exceed dispatches x
+        # the deepest lane, and the fused window actually engaged
+        assert stats["counters"]["serve/steps"] <= (
+            stats["counters"]["serve/dispatches"]
+            * max(config.serve_decode_depth)
+        )
+
         # /metrics exports the step distribution + occupancy gauges
         body = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics", timeout=30
         ).read().decode()
         assert 'sat_gauge{name="serve/decode_steps_p50"}' in body
         assert 'sat_gauge{name="serve/slot_occupancy"}' in body
+        assert 'sat_gauge{name="serve/steps_per_dispatch"}' in body
+        assert 'sat_gauge{name="serve/steps_per_dispatch_p95"}' in body
     finally:
         server.shutdown()
 
@@ -589,3 +776,19 @@ def test_cli_serve_mode_flag():
     assert config.serve_mode == "continuous"
     with pytest.raises(SystemExit):
         build_config(["--phase=serve", "--serve_mode=nope"])
+
+
+def test_cli_serve_decode_depth_flag():
+    from sat_tpu.cli import build_config
+
+    config, _ = build_config(
+        ["--phase=serve", "--serve_decode_depth=1,2,4"]
+    )
+    assert config.serve_decode_depth == (1, 2, 4)
+    # --set rides the tuple-coercion path of _parse_override
+    config, _ = build_config(
+        ["--phase=serve", "--set", "serve_decode_depth=1,6"]
+    )
+    assert config.serve_decode_depth == (1, 6)
+    with pytest.raises(ValueError):
+        build_config(["--phase=serve", "--serve_decode_depth=2,4"])
