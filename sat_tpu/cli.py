@@ -230,6 +230,17 @@ def build_config(argv: Optional[List[str]] = None):
              "decode window')",
     )
     p.add_argument(
+        "--tenants", default=None, metavar="SPEC",
+        help="serve/route phase: multi-tenant registry — a JSON file path "
+             "or an inline 'name[:weight[:rps[:burst]]],...' list (first "
+             "entry = the default tenant for requests without X-Tenant). "
+             "Tenants get weighted deficit-round-robin scheduling, "
+             "token-bucket admission quotas, per-tenant SLO burn lanes, "
+             "and optional per-tenant resident models (docs/SERVING.md "
+             "'Multi-tenant serving'; default Config.tenants='' = "
+             "single-tenant)",
+    )
+    p.add_argument(
         "--encoder_quant", choices=("off", "bf16", "int8"), default=None,
         help="serve phase: post-training quantization of the frozen CNN "
              "encoder at param load, before AOT warmup (docs/SERVING.md "
@@ -395,6 +406,8 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(serve_decode_depth=tuple(
             int(k) for k in args.serve_decode_depth.split(",") if k
         ))
+    if args.tenants is not None:
+        config = config.replace(tenants=args.tenants)
     if args.encoder_quant is not None:
         config = config.replace(encoder_quant=args.encoder_quant)
     if args.model_reload is not None:

@@ -239,6 +239,14 @@ class Config:
     # empty, K=1 under burst so admission latency is preserved.  Must
     # include 1 (the burst depth) and be strictly increasing.
     serve_decode_depth: Tuple[int, ...] = (1, 2, 4, 8)
+    # multi-tenant plane (sat_tpu/serve/tenants.py; docs/SERVING.md
+    # "Multi-tenant serving"): a JSON registry file path or an inline
+    # "name[:weight[:rps[:burst]]],..." list (first entry = the default
+    # tenant for bare requests).  Tenants get weighted deficit-round-
+    # robin scheduling, token-bucket admission quotas, per-tenant SLO
+    # burn lanes, and optional per-tenant resident models.  "" = the
+    # single-tenant plane (bit-identical to pre-tenant serving).
+    tenants: str = ""
 
     # ---- model lifecycle (sat_tpu/lifecycle; docs/SERVING.md) ----
     # zero-downtime model refresh: a reloader thread polls the lineage

@@ -42,6 +42,7 @@ import numpy as np
 from .. import telemetry
 from ..resilience import faultinject
 from .engine import BucketOverflow
+from .scheduler import DeficitRoundRobin
 
 
 class _WedgeTimeout(Exception):
@@ -64,12 +65,20 @@ def choose_decode_depth(
 
 
 class Rejected(Exception):
-    """Admission refused; ``status`` is the HTTP code the frontend maps."""
+    """Admission refused; ``status`` is the HTTP code the frontend maps.
 
-    def __init__(self, status: int, reason: str):
+    ``scope`` distinguishes a *tenant-scoped* shed (that tenant's queue
+    lane or token bucket is full — other tenants are unaffected) from a
+    *global* one (drain, fleet saturation); the frontend surfaces it as
+    the ``X-Shed-Scope`` response header and computes the Retry-After
+    hint from the matching signal (tenant bucket refill vs. service
+    p50)."""
+
+    def __init__(self, status: int, reason: str, scope: str = "global"):
         super().__init__(reason)
         self.status = status
         self.reason = reason
+        self.scope = scope
 
 
 @dataclass
@@ -84,10 +93,13 @@ class Request:
     result: Optional[Dict[str, Any]] = None
     error: Optional[Tuple[int, str]] = None
     bucket: Optional[int] = None
-    # which engine param slot serves this request ("incumbent" or
-    # "canary"); stamped by the lifecycle router at admission and honored
-    # by both dispatch disciplines
+    # which engine param slot serves this request ("incumbent", "canary"
+    # or a resident-model alias); stamped at admission and honored by
+    # both dispatch disciplines
     slot: str = "incumbent"
+    # which tenant submitted this request — the DRR scheduler drains its
+    # lane in deficit order; "default" is the bare-request tenant
+    tenant: str = "default"
     # request-scoped tracing (telemetry.tracectx): stamped when the
     # gather loop pops this request; the trace rides along so the batcher
     # can attribute each phase to the originating X-Request-Id
@@ -117,13 +129,18 @@ class _BatcherBase:
         tel=None,
         on_wedge: Optional[Callable[[], None]] = None,
         wedge_timeout_ms: Optional[float] = None,
+        weights: Optional[Dict[str, float]] = None,
     ) -> None:
         config = engine.config
         self.engine = engine
         depth = int(
             queue_depth if queue_depth is not None else config.serve_queue_depth
         )
-        self._q: "queue.Queue[Request]" = queue.Queue(maxsize=depth)
+        # admission queue: per-tenant sub-queues drained in weighted
+        # deficit order (serve/scheduler.py).  Without a weights table
+        # this is a single default lane popping in exact FIFO order —
+        # the pre-tenant behavior, bit for bit.
+        self._q = DeficitRoundRobin(maxsize=depth, weights=weights)
         self._tel = tel if tel is not None else telemetry.get()
         # wedge containment (docs/SERVING.md degraded health): when > 0,
         # the result drain of each in-flight dispatch is bounded — a
@@ -159,9 +176,12 @@ class _BatcherBase:
         deadline_unix: Optional[float] = None,
         trace: Optional[Any] = None,
         slot: str = "incumbent",
+        tenant: str = "default",
     ) -> Request:
         """Admit one preprocessed image; raises Rejected(503) while
-        draining and Rejected(429) when the queue is full."""
+        draining and Rejected(429) when the tenant's queue lane is full
+        (a tenant-scoped shed under a multi-tenant scheduler — one
+        tenant's backlog never consumes another's queue space)."""
         if self._draining.is_set():
             self._tel.count("serve/rejected_draining")
             raise Rejected(503, "server is draining; not accepting work")
@@ -171,11 +191,20 @@ class _BatcherBase:
             deadline_unix=deadline_unix,
             trace=trace,
             slot=slot,
+            tenant=tenant,
         )
         try:
             self._q.put_nowait(req)
         except queue.Full:
             self._tel.count("serve/shed")
+            if self._q.multi:
+                self._tel.count(f"serve/tenant_{tenant}_shed")
+                raise Rejected(
+                    429,
+                    f"tenant {tenant!r} queue full "
+                    f"({self._q.maxsize} waiting); shed",
+                    scope="tenant",
+                ) from None
             raise Rejected(
                 429, f"queue full ({self._q.maxsize} waiting); shed"
             ) from None
@@ -185,6 +214,10 @@ class _BatcherBase:
 
     def queue_depth(self) -> int:
         return self._q.qsize()
+
+    def tenant_depths(self) -> Dict[str, int]:
+        """Per-tenant queued depth (the /stats tenants block)."""
+        return self._q.depths()
 
     @property
     def draining(self) -> bool:
@@ -299,6 +332,7 @@ class MicroBatcher(_BatcherBase):
         pipeline_depth: int = 1,
         on_wedge: Optional[Callable[[], None]] = None,
         wedge_timeout_ms: Optional[float] = None,
+        weights: Optional[Dict[str, float]] = None,
     ) -> None:
         super().__init__(
             engine,
@@ -306,6 +340,7 @@ class MicroBatcher(_BatcherBase):
             tel=tel,
             on_wedge=on_wedge,
             wedge_timeout_ms=wedge_timeout_ms,
+            weights=weights,
         )
         config = engine.config
         self.max_batch = int(
@@ -530,6 +565,7 @@ class ContinuousBatcher(_BatcherBase):
         tel=None,
         on_wedge: Optional[Callable[[], None]] = None,
         wedge_timeout_ms: Optional[float] = None,
+        weights: Optional[Dict[str, float]] = None,
     ) -> None:
         super().__init__(
             engine,
@@ -537,6 +573,7 @@ class ContinuousBatcher(_BatcherBase):
             tel=tel,
             on_wedge=on_wedge,
             wedge_timeout_ms=wedge_timeout_ms,
+            weights=weights,
         )
         if pool is None:
             from .slot_pool import PagedSlotPool
@@ -554,12 +591,19 @@ class ContinuousBatcher(_BatcherBase):
         # window; requests that can't be seeded because their slot's pool
         # is full wait here — held, never dropped
         self._canary_pool = None
+        # multi-tenant resident models: one clone_warmed pool per
+        # resident param slot, created lazily ON the loop thread the
+        # first time a request routes to that slot (same single-owner
+        # discipline as the canary pool; shares every AOT executable, so
+        # a resident's first request costs zero compiles)
+        self._model_pools: Dict[str, Any] = {}
         self._pending: List[Request] = []
 
     def _pools(self) -> List[Any]:
         pools = [self.pool]
         if self._canary_pool is not None:
             pools.append(self._canary_pool)
+        pools.extend(self._model_pools.values())
         return pools
 
     def _occupancy_total(self) -> int:
@@ -633,10 +677,13 @@ class ContinuousBatcher(_BatcherBase):
         up to each pool's free capacity.  A request whose pool is full
         stays in ``_pending`` (consumed first next iteration) — the
         lifecycle plane must never drop or fail work just because the
-        canary pool is briefly saturated."""
+        canary pool is briefly saturated.  Requests arrive here in the
+        scheduler's deficit order, so slot seats are granted in deficit
+        order too."""
         pools = {"incumbent": self.pool}
         if self._canary_pool is not None:
             pools["canary"] = self._canary_pool
+        pools.update(self._model_pools)
         free = {k: p.free_count() for k, p in pools.items()}
         headroom = sum(free.values()) - len(self._pending)
         reqs = self._pending
@@ -645,7 +692,20 @@ class ContinuousBatcher(_BatcherBase):
         self._pending = []
         groups: Dict[str, List[Request]] = {k: [] for k in pools}
         for r in reqs:
-            slot = r.slot if r.slot in pools else "incumbent"
+            slot = r.slot
+            if slot not in pools:
+                if self.engine.has_resident(slot):
+                    # first request for this resident model: clone the
+                    # warmed pool on this (the loop) thread — zero
+                    # compiles, fresh carry — and hold the request one
+                    # tick so it seeds into the new pool next iteration
+                    pool = self.pool.clone_warmed(slot)
+                    self._model_pools[slot] = pool
+                    pools[slot] = pool
+                    free[slot] = 0
+                    groups[slot] = []
+                else:
+                    slot = "incumbent"
             if len(groups[slot]) < free[slot]:
                 groups[slot].append(r)
             else:
@@ -788,6 +848,8 @@ class ContinuousBatcher(_BatcherBase):
                 # re-clone so the canary pool shares the freshly proven
                 # executables and starts from an empty carry too
                 self._canary_pool = self.pool.clone_warmed("canary")
+            for slot in list(self._model_pools):
+                self._model_pools[slot] = self.pool.clone_warmed(slot)
         finally:
             ev.set()
 

@@ -179,39 +179,49 @@ class ServeEngine:
         # arguments to the AOT programs, so the swap is a pointer flip,
         # never a compile).  None = no candidate staged.
         self._candidate: Optional[Dict[str, Any]] = None
+        # multi-tenant resident models (docs/SERVING.md): N additional
+        # device-resident param sets keyed by alias, each aval-validated
+        # against the incumbent so they ALL run through the same warmed
+        # executables — N models, one compiled ladder, zero extra
+        # compiles
+        self._residents: Dict[str, Dict[str, Any]] = {}
 
-    # -- param slots (lifecycle plane) ------------------------------------
+    # -- param slots (lifecycle + multi-tenant planes) ---------------------
 
     def slot_variables(self, slot: str = "incumbent") -> Dict[str, Any]:
         """The encode variables for ``slot``.  The canary slot falls back
         to the incumbent when no candidate is staged — in-flight canary
         work during a rollback completes against real params instead of
-        crashing."""
+        crashing.  A resident-model alias resolves its own tree."""
         if slot == "canary" and self._candidate is not None:
             return self._candidate["variables"]
+        resident = self._residents.get(slot)
+        if resident is not None:
+            return resident["variables"]
         return self._variables
 
     def slot_decoder_params(self, slot: str = "incumbent"):
         if slot == "canary" and self._candidate is not None:
             return self._candidate["decoder_params"]
+        resident = self._residents.get(slot)
+        if resident is not None:
+            return resident["decoder_params"]
         return self._decoder_params
 
     @property
     def candidate_step(self) -> Optional[int]:
         return None if self._candidate is None else self._candidate["step"]
 
-    def install_candidate(
-        self, variables: Dict[str, Any], decoder_params, step: int,
-        source: str,
+    def _validate_compat(
+        self, variables: Dict[str, Any], decoder_params, source: str,
+        what: str = "candidate",
     ) -> None:
-        """Stage a candidate param tree in the second slot.
-
-        The candidate MUST be executable by the incumbent's warmed
+        """Assert a param tree is executable by the incumbent's warmed
         programs — same treedef, same leaf shapes and dtypes — or the
-        first canary dispatch would either recompile (jit path) or crash
-        (AOT path).  Verified here, before the candidate can see a
-        request; a mismatch raises ValueError and the caller rejects the
-        checkpoint's lineage entry."""
+        first dispatch against it would either recompile (jit path) or
+        crash (AOT path).  Shared by the lifecycle candidate slot and
+        the multi-tenant resident slots; a mismatch raises ValueError
+        before the tree can see a request."""
         import jax
 
         for name, have, want in (
@@ -222,17 +232,26 @@ class ServeEngine:
             want_leaves, want_def = jax.tree_util.tree_flatten(want)
             if have_def != want_def:
                 raise ValueError(
-                    f"candidate {name} tree structure differs from the "
+                    f"{what} {name} tree structure differs from the "
                     f"incumbent ({source}): warmed executables cannot "
                     "run it"
                 )
             for h, w in zip(have_leaves, want_leaves):
                 if h.shape != w.shape or h.dtype != w.dtype:
                     raise ValueError(
-                        f"candidate {name} leaf {h.shape}/{h.dtype} vs "
+                        f"{what} {name} leaf {h.shape}/{h.dtype} vs "
                         f"incumbent {w.shape}/{w.dtype} ({source}): "
                         "geometry drift, rejecting"
                     )
+
+    def install_candidate(
+        self, variables: Dict[str, Any], decoder_params, step: int,
+        source: str,
+    ) -> None:
+        """Stage a candidate param tree in the second slot, verified
+        runnable by the warmed executables (``_validate_compat``); the
+        caller rejects the checkpoint's lineage entry on mismatch."""
+        self._validate_compat(variables, decoder_params, source)
         self._candidate = {
             "variables": variables,
             "decoder_params": decoder_params,
@@ -262,6 +281,45 @@ class ServeEngine:
         and the canary slot falls back to it for any stragglers."""
         self._candidate = None
         self._tel.gauge("lifecycle/candidate_step", -1)
+
+    # -- resident models (multi-tenant plane) ------------------------------
+
+    def install_resident(
+        self, alias: str, variables: Dict[str, Any], decoder_params,
+        step: int, source: str,
+    ) -> None:
+        """Register a device-resident param set under ``alias``
+        (``X-Model`` / a tenant's default model).  Aval-validated like a
+        lifecycle candidate — every resident runs through the SAME
+        warmed executables, so serving N models costs zero additional
+        compiles (the acceptance criterion tests/test_tenants.py pins).
+        The two lifecycle slot names are reserved."""
+        if alias in ("incumbent", "canary"):
+            raise ValueError(
+                f"resident alias {alias!r} collides with a lifecycle "
+                "slot name"
+            )
+        self._validate_compat(
+            variables, decoder_params, source, what=f"resident {alias!r}"
+        )
+        self._residents[alias] = {
+            "variables": variables,
+            "decoder_params": decoder_params,
+            "step": int(step),
+            "source": source,
+        }
+        self._tel.gauge("serve/resident_models", len(self._residents))
+
+    def has_resident(self, alias: str) -> bool:
+        return alias in self._residents
+
+    def resident_step(self, alias: str) -> Optional[int]:
+        resident = self._residents.get(alias)
+        return None if resident is None else resident["step"]
+
+    @property
+    def resident_aliases(self) -> Tuple[str, ...]:
+        return tuple(self._residents)
 
     # -- startup -----------------------------------------------------------
 

@@ -65,6 +65,7 @@ from ..telemetry import run_id as _run_id
 from ..telemetry.exporters import rotating_append
 from ..telemetry.fleet import straggler_verdict
 from .replica import Endpoint, LocalFleet, parse_endpoints, probe_health
+from .tenants import TenantRegistry
 
 # statuses that justify the single cross-replica retry: the replica
 # failed (5xx), refused (connection error maps to None), or shed (429 —
@@ -343,6 +344,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
             rid,
             content_type=self.headers.get("Content-Type"),
             deadline_ms=self.headers.get("X-Deadline-Ms"),
+            tenant=self.headers.get("X-Tenant"),
+            model=self.headers.get("X-Model"),
         )
         self._send(status, payload_bytes, ctype, rid, headers=headers)
 
@@ -363,6 +366,12 @@ class Router:
         self.config = config
         self.endpoints = {e.name: e for e in endpoints}
         self.fleet = fleet
+        # tenant plane at the edge: the router enforces each tenant's
+        # token-bucket quota BEFORE a pick so over-quota floods are shed
+        # here (tenant-scoped 429) instead of consuming replica queue
+        # space N different ways downstream.  "" = single-tenant: no
+        # bucket, no per-tenant counters, bit-identical routing.
+        self.tenants = TenantRegistry.parse(config.tenants)
         self._tel = telemetry.get()
         self._host = host if host is not None else config.serve_host
         self._requested_port = port if port is not None else config.route_port
@@ -625,6 +634,8 @@ class Router:
         rid: str,
         content_type: Optional[str],
         deadline_ms: Optional[str],
+        tenant: Optional[str] = None,
+        model: Optional[str] = None,
     ) -> Tuple[int, bytes, str, Dict[str, str]]:
         """One upstream attempt over the keep-alive pool.  Raises
         OSError/HTTPException on socket-level failure (the retryable
@@ -636,6 +647,10 @@ class Router:
         }
         if deadline_ms:
             headers["X-Deadline-Ms"] = deadline_ms
+        if tenant:
+            headers["X-Tenant"] = tenant
+        if model:
+            headers["X-Model"] = model
         pool = self._pools[name]
         conn = pool.checkout()
         try:
@@ -644,9 +659,10 @@ class Router:
             data = resp.read()
             ctype = resp.getheader("Content-Type") or "application/json"
             extra = {}
-            retry_after = resp.getheader("Retry-After")
-            if retry_after:
-                extra["Retry-After"] = retry_after
+            for header in ("Retry-After", "X-Shed-Scope"):
+                value = resp.getheader(header)
+                if value:
+                    extra[header] = value
             pool.checkin(conn)
             return resp.status, data, ctype, extra
         except (OSError, http.client.HTTPException):
@@ -659,12 +675,27 @@ class Router:
         rid: str,
         content_type: Optional[str] = None,
         deadline_ms: Optional[str] = None,
+        tenant: Optional[str] = None,
+        model: Optional[str] = None,
     ) -> Tuple[int, bytes, str, Dict[str, str]]:
         """Route one /caption: weighted pick, at most one retry on a
         DIFFERENT replica for refused/5xx/shed, coherent 429 at the
-        edge.  Returns (status, body, content_type, extra_headers)."""
+        edge.  With a tenant registry, each tenant's token-bucket quota
+        is enforced BEFORE the pick — an over-quota request is a
+        tenant-scoped 429 (``X-Shed-Scope: tenant``, ``Retry-After``
+        from THAT bucket's refill) that never consumes replica queue
+        space.  Returns (status, body, content_type, extra_headers)."""
         t0 = time.perf_counter_ns()
         self._tel.count("route/requests")
+        tname: Optional[str] = None
+        if self.tenants.multi:
+            spec = self.tenants.resolve(tenant)
+            tname = spec.name
+            if tenant and not self.tenants.known(tenant):
+                self._tel.count("route/tenant_unknown")
+            self._tel.count(f"route/tenant_{tname}_requests")
+            if not self.tenants.try_admit(tname):
+                return self._shed_tenant(t0, rid, spec)
         view = self.view()
         if not view["routable"]:
             self._tel.count("route/no_replicas")
@@ -699,7 +730,8 @@ class Router:
             self._note_outstanding(name, +1)
             try:
                 status, data, ctype, extra = self._forward(
-                    name, body, rid, content_type, deadline_ms
+                    name, body, rid, content_type, deadline_ms,
+                    tenant=tenant, model=model,
                 )
             except (OSError, http.client.HTTPException):
                 self._tel.count("route/upstream_errors")
@@ -712,6 +744,11 @@ class Router:
             if status >= 500 or status in _RETRYABLE:
                 self._tel.count("route/upstream_5xx" if status >= 500
                                 else "route/upstream_sheds")
+                if status == 429 and extra.get("X-Shed-Scope") == "tenant":
+                    # a tenant-quota 429 is about the TENANT, not the
+                    # replica: another replica enforces the same quota,
+                    # so the retry would only double-charge the bucket
+                    break
                 continue
             break
         if status == 0:
@@ -730,6 +767,17 @@ class Router:
                 {"Retry-After": str(self._fleet_retry_after_s())},
             )
         if status == 429:
+            if extra.get("X-Shed-Scope") == "tenant":
+                # the replica shed ONE tenant's quota/queue: pass it
+                # through verbatim (scope + that tenant's Retry-After) —
+                # re-minting a fleet-coherent 429 would tell a
+                # well-behaved tenant the whole fleet is saturated
+                if tname is not None:
+                    self._tel.count(f"route/tenant_{tname}_shed")
+                return self._finish(
+                    t0, rid, status, attempts[-1], upstream_ns, data,
+                    ctype, extra,
+                )
             # coherent edge shed: ONE 429 with the fleet-wide hint, not
             # whichever per-replica Retry-After the last attempt carried
             return self._shed(t0, rid, replica=attempts[-1],
@@ -752,12 +800,40 @@ class Router:
             {
                 "error": "fleet saturated; retry later",
                 "retry_after_ms": secs * 1000,
+                "shed_scope": "global",
                 "request_id": rid,
             }
         ).encode()
         return self._finish(
             t0, rid, 429, replica, upstream_ns, body, "application/json",
-            {"Retry-After": str(secs)},
+            {"Retry-After": str(secs), "X-Shed-Scope": "global"},
+        )
+
+    def _shed_tenant(
+        self, t0: int, rid: str, spec
+    ) -> Tuple[int, bytes, str, Dict[str, str]]:
+        """Tenant-scoped edge shed: the bucket is dry, so the hint is
+        THAT bucket's refill time — not the fleet p50, which says
+        nothing about when this tenant's quota frees up."""
+        self._tel.count("route/sheds")
+        self._tel.count(f"route/tenant_{spec.name}_shed")
+        retry_s = self.tenants.retry_after_s(spec.name)
+        secs = int(min(30, max(1, math.ceil(retry_s))))
+        body = json.dumps(
+            {
+                "error": (
+                    f"tenant {spec.name!r} admission quota exhausted "
+                    f"({spec.rps:g} rps); shed"
+                ),
+                "retry_after_ms": max(1, int(retry_s * 1000.0) + 1),
+                "shed_scope": "tenant",
+                "tenant": spec.name,
+                "request_id": rid,
+            }
+        ).encode()
+        return self._finish(
+            t0, rid, 429, None, 0, body, "application/json",
+            {"Retry-After": str(secs), "X-Shed-Scope": "tenant"},
         )
 
     def _finish(
@@ -946,6 +1022,8 @@ class Router:
         }
         if view["straggler"].get("verdict"):
             payload["straggler"] = view["straggler"]
+        if self.tenants.multi:
+            payload["tenants"] = sorted(self.tenants.names())
         return payload, (200 if routable else 503)
 
     def stats(self) -> Dict[str, Any]:
@@ -958,6 +1036,24 @@ class Router:
                 latency[name] = p
         with self._drain_lock:
             drain_log = list(self._drain_log)
+        tenants_block = None
+        if self.tenants.multi:
+            tenants_block = {}
+            for spec in self.tenants.specs():
+                tokens = self.tenants.tokens(spec.name)
+                tenants_block[spec.name] = {
+                    "weight": spec.weight,
+                    "rps": spec.rps,
+                    "tokens": (
+                        round(tokens, 3) if tokens is not None else None
+                    ),
+                    "requests": counters.get(
+                        f"route/tenant_{spec.name}_requests", 0
+                    ),
+                    "shed": counters.get(
+                        f"route/tenant_{spec.name}_shed", 0
+                    ),
+                }
         return {
             "role": "router",
             "ready": bool(view["routable"]),
@@ -975,6 +1071,7 @@ class Router:
                 name: pool.connects for name, pool in self._pools.items()
             },
             "drain_log": drain_log,
+            **({"tenants": tenants_block} if tenants_block else {}),
         }
 
     def metrics_text(self) -> str:
@@ -1092,6 +1189,19 @@ def route(config: Config) -> int:
         file=sys.stderr,
         flush=True,
     )
+    if router.tenants.multi:
+        plan = ", ".join(
+            f"{s.name}(w={s.weight:g}"
+            + (f", {s.rps:g}rps" if s.rps > 0 else "")
+            + ")"
+            for s in router.tenants.specs()
+        )
+        print(
+            f"sat_tpu: router tenant plane: {plan}; default "
+            f"{router.tenants.default!r}",
+            file=sys.stderr,
+            flush=True,
+        )
     try:
         router.serve_until_shutdown()
     finally:

@@ -10,9 +10,14 @@ Endpoints:
 
 * ``POST /caption`` — body: JPEG/PNG bytes.  200 → ``{"captions": [{
   "caption", "log_prob", "prob"}, ...beam-ordered], "bucket",
-  "model_step"}``.  400 undecodable body, 429 queue full (shed), 503
+  "model_step"}``.  400 undecodable body, 429 queue/quota shed, 503
   draining, 504 deadline/timeout.  ``X-Deadline-Ms`` (integer) overrides
-  ``Config.serve_deadline_ms`` per request.
+  ``Config.serve_deadline_ms`` per request.  Under ``--tenants``,
+  ``X-Tenant`` selects the tenant (quota bucket, scheduling weight, SLO
+  lane; bare/unknown keys map to the default tenant) and ``X-Model``
+  pins a resident param set; every 429/503 carries ``X-Shed-Scope:
+  tenant|global`` with a scope-matched ``Retry-After`` (tenant bucket
+  refill vs. observed service period).
 * ``GET /healthz`` — readiness + the run-health heartbeat payload
   (telemetry.Heartbeat — same fields watchers poll from heartbeat.json).
   200 ready, 503 draining/stopped: a load balancer needs only the code.
@@ -63,6 +68,7 @@ from ..telemetry.slo import SLOEngine, objectives_from_config
 from .batcher import ContinuousBatcher, MicroBatcher, Rejected
 from .engine import ServeEngine, load_serving_state
 from .slot_pool import PagedSlotPool
+from .tenants import TenantRegistry
 
 _LATENCY_SPANS = (
     "serve/request",
@@ -215,16 +221,24 @@ class _Handler(BaseHTTPRequestHandler):
             body,
             deadline_ms=self.headers.get("X-Deadline-Ms"),
             request_id=rid,
+            tenant=self.headers.get("X-Tenant"),
+            model=self.headers.get("X-Model"),
         )
         headers = None
         if status in (429, 503) and "retry_after_ms" in payload:
             # RFC 7231 Retry-After is whole seconds; round up so a
-            # compliant client never comes back before the hint.  One
-            # contract for both shed shapes: 429 queue sheds and 503
-            # drain-rejects carry the same header the router's coherent
-            # edge shed speaks.
+            # compliant client never comes back before the hint (the
+            # never-0s clamp).  One contract for both shed shapes: 429
+            # queue/quota sheds and 503 drain-rejects carry the same
+            # header the router's coherent edge shed speaks, and
+            # X-Shed-Scope says WHOSE capacity ran out — "tenant" (your
+            # bucket/lane; backing off helps only you) vs "global" (the
+            # service; everyone should back off).
             secs = max(1, int(-(-payload["retry_after_ms"] // 1000)))
-            headers = {"Retry-After": str(secs)}
+            headers = {
+                "Retry-After": str(secs),
+                "X-Shed-Scope": payload.get("shed_scope", "global"),
+            }
         self._reply(status, payload, rid, headers=headers)
 
 
@@ -247,6 +261,15 @@ class CaptionServer:
         self.config = config
         self.engine = engine
         self._tel = telemetry.get()
+        # multi-tenant plane (docs/SERVING.md): the registry maps
+        # X-Tenant → quota bucket / scheduling weight / resident model /
+        # SLO lane.  The empty --tenants spec is the degenerate
+        # single-tenant registry (multi=False): no buckets, no weights
+        # table, no per-tenant counters — the pre-tenant serving path,
+        # bit for bit.
+        self.tenants = TenantRegistry.parse(config.tenants)
+        self._load_residents()
+        weights = self.tenants.weights() if self.tenants.multi else None
         # admission knobs come from THIS server's config (which may be a
         # replace() of the engine's — e.g. a tighter queue for the same
         # warmed engine), not the engine's defaults
@@ -265,6 +288,7 @@ class CaptionServer:
                 tel=self._tel,
                 on_wedge=self._on_wedge,
                 wedge_timeout_ms=config.serve_wedge_timeout_ms,
+                weights=weights,
             )
         else:
             self.batcher = MicroBatcher(
@@ -275,6 +299,7 @@ class CaptionServer:
                 tel=self._tel,
                 on_wedge=self._on_wedge,
                 wedge_timeout_ms=config.serve_wedge_timeout_ms,
+                weights=weights,
             )
         self._host = host if host is not None else config.serve_host
         self._requested_port = (
@@ -309,7 +334,13 @@ class CaptionServer:
         self.profiles = ProfileLatch(tdir)
         self.slo = SLOEngine(
             self._tel,
-            objectives_from_config(config, "serve"),
+            objectives_from_config(
+                config,
+                "serve",
+                tenants=self.tenants.slo_lanes(
+                    config.slo_serve_p99_ms, config.slo_error_ratio
+                ),
+            ),
             jsonl_path=os.path.join(tdir, "slo.jsonl"),
             cap_bytes=cap_bytes,
             fast_s=config.slo_window_fast_s,
@@ -322,6 +353,31 @@ class CaptionServer:
         self.lifecycle = LifecycleController(
             config, engine, self.batcher, tel=self._tel
         )
+
+    def _load_residents(self) -> None:
+        """Load every registry-declared resident model into the engine
+        through the lifecycle loader (integrity + vocab + full-coverage
+        guards), each aval-validated against the incumbent so all share
+        the warmed AOT executables.  A resident that fails its guards is
+        a boot error — a tenant pointed at a model that cannot serve
+        must not silently fall back to the incumbent."""
+        for alias, path in sorted(self.tenants.models.items()):
+            from ..lifecycle.loader import load_candidate
+
+            staged = load_candidate(self.engine, self.config, path)
+            self.engine.install_resident(
+                alias,
+                staged["variables"],
+                staged["decoder_params"],
+                staged["step"],
+                staged["source"],
+            )
+            print(
+                f"sat_tpu: resident model {alias!r} loaded from {path} "
+                f"(step {staged['step']})",
+                file=sys.stderr,
+                flush=True,
+            )
 
     @property
     def port(self) -> Optional[int]:
@@ -345,6 +401,7 @@ class CaptionServer:
         payload: Dict[str, Any],
         bucket: Optional[int] = None,
         slot: str = canary_mod.INCUMBENT,
+        tenant: Optional[str] = None,
     ) -> Tuple[int, Dict[str, Any]]:
         """Every terminal /caption reply funnels through here: the access
         log gets its record, the SLO error-ratio counters tick, and the
@@ -355,6 +412,21 @@ class CaptionServer:
         self._tel.count("serve/http_requests")
         if status >= 500:
             self._tel.count("serve/http_5xx")
+        if tenant is not None and self.tenants.multi:
+            # per-tenant SLO lane feed (same pattern as the canary lane
+            # below): each tenant's own latency span and error-ratio
+            # counters, so one tenant burning its objectives never
+            # muddies another's — and the tenant dimension rides the
+            # metric NAME, so /metrics exports it with no promtext
+            # changes
+            self._tel.count(f"serve/tenant_{tenant}_requests")
+            if status >= 500:
+                self._tel.count(f"serve/tenant_{tenant}_5xx")
+            if status == 429:
+                self._tel.count(f"serve/tenant_{tenant}_429")
+            self._tel.record(
+                f"serve/tenant_{tenant}_request", trace.t_start_ns, total_ns
+            )
         if slot == canary_mod.CANARY:
             # the canary SLO engine scores ONLY canary-slot traffic: its
             # own latency span and error-ratio counters, so a bad
@@ -377,13 +449,22 @@ class CaptionServer:
         return status, payload
 
     def handle_caption(
-        self, body: bytes, deadline_ms=None, request_id=None
+        self, body: bytes, deadline_ms=None, request_id=None,
+        tenant=None, model=None,
     ) -> Tuple[int, Dict[str, Any]]:
         t_req0 = time.perf_counter_ns()
         trace = self.tracer.begin(request_id)
         trace.t_start_ns = t_req0
         with self._in_flight_lock:
             self._in_flight += 1  # paired decrement in _finish_request
+        # tenant resolution: X-Tenant → registry spec (bare and unknown
+        # keys map to the default tenant).  tname is None on the
+        # degenerate single-tenant registry so no per-tenant counters or
+        # payload fields appear — zero behavior change without --tenants
+        spec = self.tenants.resolve(tenant)
+        tname = spec.name if self.tenants.multi else None
+        if tenant and tname is not None and not self.tenants.known(tenant):
+            self._tel.count("serve/tenant_unknown")
         if not self._ready:
             return self._finish_request(
                 trace,
@@ -393,7 +474,29 @@ class CaptionServer:
                     # same backoff contract as a 429 shed: tell the
                     # client when capacity is expected, never 0 seconds
                     "retry_after_ms": self._retry_hint_ms(),
+                    "shed_scope": "global",
                 },
+                tenant=tname,
+            )
+        # token-bucket admission quota, enforced BEFORE preprocessing so
+        # a flooding tenant is refused at the cost of a dict lookup: a
+        # dry bucket is a tenant-scoped 429 whose Retry-After is that
+        # bucket's own refill time, not the service p50
+        if tname is not None and not self.tenants.try_admit(spec.name):
+            self._tel.count("serve/shed")
+            self._tel.count(f"serve/tenant_{spec.name}_shed")
+            return self._finish_request(
+                trace,
+                429,
+                {
+                    "error": (
+                        f"tenant {spec.name!r} admission quota exhausted "
+                        f"({spec.rps:g} rps); shed"
+                    ),
+                    "retry_after_ms": self._tenant_retry_ms(spec.name),
+                    "shed_scope": "tenant",
+                },
+                tenant=tname,
             )
         try:
             with self._tel.span("serve/preprocess"):
@@ -409,6 +512,7 @@ class CaptionServer:
                     "error": "bad image",
                     "detail": f"cannot decode image bytes: {e}",
                 },
+                tenant=tname,
             )
         if deadline_ms is None or deadline_ms == "":
             budget_ms = self.config.serve_deadline_ms
@@ -420,22 +524,51 @@ class CaptionServer:
                     trace,
                     400,
                     {"error": "X-Deadline-Ms must be integer milliseconds"},
+                    tenant=tname,
                 )
         deadline_unix = (
             time.time() + budget_ms / 1e3 if budget_ms > 0 else None
         )
-        # lifecycle canary routing: a deterministic, sticky hash of the
-        # request id — outside a canary window every request is incumbent
-        slot = self.lifecycle.route(trace.trace_id)
+        # param-slot selection: an explicit X-Model (or the tenant's
+        # default model) pins a resident param set; otherwise the
+        # lifecycle canary router decides (a deterministic, sticky hash
+        # of the request id — outside a canary window every request is
+        # incumbent)
+        alias = (model or "").strip() or spec.model
+        if alias:
+            if not self.engine.has_resident(alias):
+                return self._finish_request(
+                    trace,
+                    400,
+                    {
+                        "error": f"unknown model {alias!r}",
+                        "models": list(self.engine.resident_aliases),
+                    },
+                    tenant=tname,
+                )
+            slot = alias
+        else:
+            slot = self.lifecycle.route(trace.trace_id)
         try:
             req = self.batcher.submit(
-                image, deadline_unix=deadline_unix, trace=trace, slot=slot
+                image, deadline_unix=deadline_unix, trace=trace, slot=slot,
+                tenant=spec.name,
             )
         except Rejected as e:
             payload = {"error": e.reason}
             if e.status in (429, 503):
-                payload["retry_after_ms"] = self._retry_hint_ms()
-            return self._finish_request(trace, e.status, payload, slot=slot)
+                # Retry-After computed from the SHEDDING SCOPE: a
+                # tenant-lane shed hints the tenant's own bucket refill,
+                # a global shed hints the observed service period
+                payload["shed_scope"] = e.scope
+                payload["retry_after_ms"] = (
+                    self._tenant_retry_ms(spec.name)
+                    if e.scope == "tenant"
+                    else self._retry_hint_ms()
+                )
+            return self._finish_request(
+                trace, e.status, payload, slot=slot, tenant=tname
+            )
         wait_s = (
             budget_ms / 1e3 + 5.0 if deadline_unix else self.DEFAULT_WAIT_S
         )
@@ -443,14 +576,16 @@ class CaptionServer:
             self._tel.count("serve/timeouts")
             return self._finish_request(
                 trace, 504, {"error": "request timed out in service"},
-                slot=slot,
+                slot=slot, tenant=tname,
             )
         if req.error is not None:
             payload = {"error": req.error[1]}
             if req.error[0] in (429, 503):
                 payload["retry_after_ms"] = self._retry_hint_ms()
+                payload["shed_scope"] = "global"
             return self._finish_request(
-                trace, req.error[0], payload, bucket=req.bucket, slot=slot
+                trace, req.error[0], payload, bucket=req.bucket, slot=slot,
+                tenant=tname,
             )
         self._tel.record(
             "serve/request", t_req0, time.perf_counter_ns() - t_req0
@@ -458,8 +593,16 @@ class CaptionServer:
         payload = dict(req.result)
         payload["bucket"] = req.bucket
         payload["slot"] = slot
+        if tname is not None:
+            payload["tenant"] = tname
         if slot == canary_mod.CANARY:
             step = self.engine.candidate_step
+            payload["model_step"] = (
+                step if step is not None else self.engine.step
+            )
+        elif alias:
+            payload["model"] = alias
+            step = self.engine.resident_step(alias)
             payload["model_step"] = (
                 step if step is not None else self.engine.step
             )
@@ -476,7 +619,7 @@ class CaptionServer:
             except (KeyError, IndexError, TypeError):
                 pass
         return self._finish_request(
-            trace, 200, payload, bucket=req.bucket, slot=slot
+            trace, 200, payload, bucket=req.bucket, slot=slot, tenant=tname
         )
 
     def _retry_hint_ms(self) -> int:
@@ -490,13 +633,27 @@ class CaptionServer:
         )
         return int(min(10_000.0, max(50.0, hint)))
 
+    def _tenant_retry_ms(self, name: str) -> int:
+        """Retry-After hint for a *tenant-scoped* shed: that tenant's
+        own bucket refill time — when its next token exists — not the
+        global service period.  Never 0 (the frontend's whole-second
+        clamp rounds it up to >= 1 s on the header)."""
+        return max(1, int(self.tenants.retry_after_s(name) * 1000.0) + 1)
+
     def healthz(self) -> Tuple[Dict[str, Any], int]:
         payload = self.heartbeat.payload() if self.heartbeat else {}
         # two degrade causes (docs/RESILIENCE.md): a wedged batch being
         # re-warmed, and a burning SLO — both flip the balancer-facing
         # health while requests are still admitted
         burning = self.slo.burning()
-        degraded = self._degraded or bool(burning)
+        # tenant-scoped lanes never degrade the replica's fleet-facing
+        # health: one tenant burning ITS objective (a flood eating its
+        # own quota) must not get the whole replica down-weighted — that
+        # would spread tenant A's overload onto tenant B, the exact
+        # failure the isolation plane exists to prevent.  The lanes stay
+        # visible in slo_burning / /metrics for per-tenant alerting.
+        service_burning = [n for n in burning if not n.startswith("tenant_")]
+        degraded = self._degraded or bool(service_burning)
         payload.update(
             {
                 "ready": self._ready,
@@ -523,6 +680,8 @@ class CaptionServer:
         candidate = self.engine.candidate_step
         if candidate is not None:
             payload["candidate_step"] = candidate
+        if self.tenants.multi:
+            payload["tenants"] = sorted(self.tenants.names())
         if burning:
             payload["slo_burning"] = burning
         return payload, (200 if self._ready and not degraded else 503)
@@ -658,7 +817,48 @@ class CaptionServer:
                 "page_width": self.pool.width,
                 "busy": self.pool.occupancy(),
             }
+        if self.tenants.multi:
+            out["tenants"] = self._tenant_block(counters)
         return out
+
+    def _tenant_block(self, counters: Dict[str, int]) -> Dict[str, Any]:
+        """Per-tenant /stats block: static shape (weight/quota/model)
+        plus live queue depth, token balance, request/shed/5xx counters
+        and latency percentiles.  Refreshes the serve/tenant_* gauges so
+        the heartbeat serve block and /metrics carry the same numbers."""
+        depths = self.batcher.tenant_depths()
+        block: Dict[str, Any] = {}
+        for name, shape in self.tenants.describe().items():
+            entry = dict(shape)
+            entry["queue_depth"] = depths.get(name, 0)
+            tokens = self.tenants.tokens(name)
+            if tokens is not None and tokens != float("inf"):  # sync-ok: host sentinel
+                entry["tokens"] = round(tokens, 2)
+                self._tel.gauge(
+                    f"serve/tenant_{name}_tokens", round(tokens, 2)
+                )
+            self._tel.gauge(
+                f"serve/tenant_{name}_queue_depth", depths.get(name, 0)
+            )
+            for short, counter in (
+                ("requests", f"serve/tenant_{name}_requests"),
+                ("shed", f"serve/tenant_{name}_shed"),
+                ("429", f"serve/tenant_{name}_429"),
+                ("5xx", f"serve/tenant_{name}_5xx"),
+            ):
+                entry[short] = counters.get(counter, 0)
+            step = (
+                self.engine.resident_step(shape["model"])
+                if shape["model"]
+                else None
+            )
+            if step is not None:
+                entry["model_step"] = step
+            p = _percentiles_ms(self._tel, f"serve/tenant_{name}_request")
+            if p:
+                entry["latency_ms"] = p
+            block[name] = entry
+        return block
 
     def _encode_lanes(self):
         """Every encode-lane width this server can have timed: the bucket
@@ -691,6 +891,11 @@ class CaptionServer:
             # rides alongside for burn-rate style alerting)
             self._tel.gauge("serve/encode_ms", enc["p50"])
             self._tel.gauge("serve/encode_ms_p95", enc["p95"])
+        if self.tenants.multi:
+            # refresh the serve/tenant_* queue/token gauges at scrape
+            # time (the tenant dimension rides the metric name, so
+            # promtext exports them with no label machinery)
+            self._tenant_block(self._tel.counters())
         extra = self.heartbeat.payload() if self.heartbeat else None
         return promtext.render(self._tel, extra=extra)
 
@@ -879,6 +1084,20 @@ def serve(config: Config, model_file: Optional[str] = None) -> int:
         file=sys.stderr,
         flush=True,
     )
+    if server.tenants.multi:
+        shapes = ", ".join(
+            f"{s.name}(w={s.weight:g}"
+            + (f", {s.rps:g}rps" if s.limited else "")
+            + (f", model={s.model}" if s.model else "")
+            + ")"
+            for s in server.tenants.specs()
+        )
+        print(
+            f"sat_tpu: multi-tenant plane active — {shapes}; "
+            f"default tenant {server.tenants.default!r}",
+            file=sys.stderr,
+            flush=True,
+        )
     try:
         server.serve_until_shutdown()
     except Exception as e:

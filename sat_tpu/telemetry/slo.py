@@ -315,10 +315,20 @@ class SLOEngine:
         self._thread = None
 
 
-def objectives_from_config(config, phase: str) -> List[Objective]:
+def objectives_from_config(config, phase: str, tenants=()) -> List[Objective]:
     """The declared objectives for a phase; a target of 0 disables that
     objective (the config default), so a run with no ``slo_*`` settings
-    gets an empty list and the engine never starts."""
+    gets an empty list and the engine never starts.
+
+    ``tenants`` (serve phase only) grows the tenant dimension: a
+    sequence of ``(name, p99_ms, error_ratio)`` lane targets — one
+    burn-rate lane pair per tenant over that tenant's own latency span
+    and error-ratio counters (``serve/tenant_<name>_request`` /
+    ``_5xx`` / ``_requests``, the per-tenant twins of the serve-wide
+    signals, fed by the server's ``_finish_request``).  The multiwindow
+    burn math is unchanged; a flooding tenant burns its own lanes while
+    everyone else's stay green (the chaos campaign's isolation
+    assertion).  Empty for single-tenant serving — no extra lanes."""
     out: List[Objective] = []
     if phase == "serve":
         if config.slo_serve_p99_ms > 0:
@@ -340,6 +350,26 @@ def objectives_from_config(config, phase: str) -> List[Objective]:
                     denom="serve/http_requests",
                 )
             )
+        for name, p99_ms, error_ratio in tenants:
+            if p99_ms > 0:
+                out.append(
+                    Objective(
+                        name=f"tenant_{name}_p99_ms",
+                        kind="latency_p99",
+                        target=p99_ms,
+                        source=f"serve/tenant_{name}_request",
+                    )
+                )
+            if error_ratio > 0:
+                out.append(
+                    Objective(
+                        name=f"tenant_{name}_error_ratio",
+                        kind="error_ratio",
+                        target=error_ratio,
+                        source=f"serve/tenant_{name}_5xx",
+                        denom=f"serve/tenant_{name}_requests",
+                    )
+                )
     elif phase == "canary":
         # the lifecycle controller's qualification objectives: the same
         # targets the serve plane declares, measured over CANARY-slot

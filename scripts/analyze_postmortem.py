@@ -152,7 +152,51 @@ def probable_cause(manifest: Dict, bundle: str) -> Dict:
                 f"({verdict.get('host')}) as a straggler "
                 f"({verdict.get('skew')}x the fleet median)"
             )
+    flooder = _flooding_tenant(_read_json(bundle, "state.json") or {})
+    if flooder:
+        out["flooding_tenant"] = flooder["tenant"]
+        out["evidence"].append(
+            f"tenant {flooder['tenant']!r} dominates the shed counters: "
+            f"{flooder['shed']} shed of {flooder['requests']} requests "
+            f"({flooder['shed_share']:.0%} of all tenant sheds) — "
+            "probable flooding tenant"
+        )
     return out
+
+
+def _flooding_tenant(state: Dict) -> Optional[Dict]:
+    """Name the tenant behind an overload from the per-tenant counters
+    the multi-tenant serve/route planes emit (``serve/tenant_<t>_shed``
+    etc.).  Returns the tenant holding the majority of tenant-scoped
+    sheds, or None when the run was single-tenant / nothing shed."""
+    counters = state.get("counters") or {}
+    shed: Dict[str, int] = {}
+    requests: Dict[str, int] = {}
+    for key, value in counters.items():
+        for prefix in ("serve/tenant_", "route/tenant_"):
+            if not key.startswith(prefix):
+                continue
+            rest = key[len(prefix):]
+            name, _, kind = rest.rpartition("_")
+            if not name or name == "unknown":
+                continue
+            if kind == "shed":
+                shed[name] = shed.get(name, 0) + int(value)
+            elif kind == "requests":
+                requests[name] = requests.get(name, 0) + int(value)
+    total_shed = sum(shed.values())
+    if total_shed <= 0:
+        return None
+    worst = max(shed, key=shed.get)
+    share = shed[worst] / total_shed
+    if share < 0.5:
+        return None  # no single tenant dominates — not a flood story
+    return {
+        "tenant": worst,
+        "shed": shed[worst],
+        "requests": requests.get(worst, 0),
+        "shed_share": share,
+    }
 
 
 def _fmt_ts(t: float, base: float) -> str:
@@ -215,7 +259,8 @@ def print_report(bundle: str, summary: Dict) -> None:
             k: v
             for k, v in sorted(gauges.items())
             if k.split("/")[0]
-            in ("train", "fleet", "watchdog", "data", "slo", "supervisor")
+            in ("train", "fleet", "watchdog", "data", "slo", "supervisor",
+                "serve", "route")
         }
         if interesting:
             print("\nfinal gauges:")

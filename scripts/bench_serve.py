@@ -44,6 +44,14 @@ scripts/check_regression.py:
   ``serve_decode_depth=1`` runs the same client and rides the row as
   ``k1_p50_ms`` / ``k1_goodput`` extras — the K-ladder A/B.  Every K
   lane asserts zero steady-state recompiles (exit 1 otherwise).
+* ``--tenants`` switches to the multi-tenant isolation campaign
+  (docs/SERVING.md "Multi-tenant serving"): one continuous-mode server
+  with a victim/peer/flood registry — ``tenant_isolation_p99_ratio``
+  (ratio, lower is better: victim p99 under a 5x-quota flood over its
+  flood-free baseline) and ``tenant_fair_share_error`` (fraction, lower
+  is better: |observed − weighted| completion share across two
+  backlogged lanes).  Exit 1 on any recompile, victim-lane shed/error
+  or flood 5xx.
 * ``--fleet`` switches to the fleet campaign (docs/SERVING.md fleet
   section): max(--fleet-sizes) subprocess replicas spawned once, then a
   matched open-loop Poisson load through the health-weighted router at
@@ -219,10 +227,12 @@ class _KeepAliveClient:
         self._lock = threading.Lock()
         self.connects = 0
 
-    def post(self, port, data, timeout=60.0, host="127.0.0.1"):
+    def post(self, port, data, timeout=60.0, host="127.0.0.1",
+             headers=None):
         """One POST /caption; returns (status, latency_s); status 0 on a
         connection-level failure (refused/reset — the chaos scenario
-        distinguishes these from HTTP 5xx)."""
+        distinguishes these from HTTP 5xx).  ``headers`` adds request
+        headers (the tenant arm sets ``X-Tenant`` per lane)."""
         t0 = time.perf_counter()
         with self._lock:
             stack = self._idle.setdefault(port, [])
@@ -234,7 +244,7 @@ class _KeepAliveClient:
         try:
             conn.request(
                 "POST", "/caption", body=data,
-                headers={"Content-Type": "image/jpeg"},
+                headers={"Content-Type": "image/jpeg", **(headers or {})},
             )
             resp = conn.getresponse()
             resp.read()
@@ -263,9 +273,9 @@ class _KeepAliveClient:
 _CLIENT = _KeepAliveClient()
 
 
-def _post(port, data, timeout=60.0):
+def _post(port, data, timeout=60.0, headers=None):
     """One POST over the shared keep-alive pool; (status, latency_s)."""
-    return _CLIENT.post(port, data, timeout=timeout)
+    return _CLIENT.post(port, data, timeout=timeout, headers=headers)
 
 
 def _get_json(port, path, timeout=10.0):
@@ -283,7 +293,7 @@ def _pcts(lat_s):
     return {"p50": pct(50), "p95": pct(95), "p99": pct(99)}
 
 
-def closed_loop(port, jpegs, concurrency, requests):
+def closed_loop(port, jpegs, concurrency, requests, headers=None):
     """concurrency workers x requests sequential POSTs each."""
     lats, codes = [], []
     lock = threading.Lock()
@@ -292,7 +302,8 @@ def closed_loop(port, jpegs, concurrency, requests):
     def worker(wid):
         local_l, local_c = [], []
         for i in range(requests):
-            status, lat = _post(port, jpegs[(wid + i) % len(jpegs)])
+            status, lat = _post(port, jpegs[(wid + i) % len(jpegs)],
+                                headers=headers)
             local_c.append(status)
             if status == 200:
                 local_l.append(lat)
@@ -322,7 +333,7 @@ def closed_loop(port, jpegs, concurrency, requests):
     }
 
 
-def open_loop(port, jpegs, rate, total, timeout=60.0):
+def open_loop(port, jpegs, rate, total, timeout=60.0, headers=None):
     """Poisson arrivals at ``rate`` req/s; each request on its own
     thread so slow responses never throttle the arrival process."""
     rng = random.Random(0)
@@ -332,7 +343,8 @@ def open_loop(port, jpegs, rate, total, timeout=60.0):
     connects0 = _CLIENT.connects
 
     def fire(i):
-        status, lat = _post(port, jpegs[i % len(jpegs)], timeout=timeout)
+        status, lat = _post(port, jpegs[i % len(jpegs)], timeout=timeout,
+                            headers=headers)
         with lock:
             codes.append(status)
             if status == 200:
@@ -547,6 +559,223 @@ def fleet_bench(args, workdir) -> int:
     # recompiling under load is the one hard failure; shed/scaling are
     # reported for the regression gate to judge
     return 0 if all(v == 0 for v in recompiles.values()) else 1
+
+
+def tenants_bench(args, workdir) -> int:
+    """--tenants: SLO isolation + fair-share on the multi-tenant plane
+    (docs/SERVING.md "Multi-tenant serving").
+
+    One continuous-mode server with a three-tenant registry: ``victim``
+    (weight 4, unlimited — the paying tenant whose p99 the plane
+    protects), ``peer`` (weight 1, unlimited — the fair-share
+    counterparty) and ``flood`` (weight 1, quota ``--tenant-flood-rps``
+    — the abuser).  Three phases:
+
+    * **alone**: victim open loop at ``--tenant-rate`` — the flood-free
+      p99 baseline;
+    * **under flood**: the SAME victim load while flood offers
+      ``--tenant-flood-rate`` (several times its quota) from background
+      threads.  ``tenant_isolation_p99_ratio`` = victim p99 under
+      flood / alone (1.0 = perfect isolation; the DRR scheduler +
+      token-bucket admission keep it near 1);
+    * **fair share**: victim and peer drive matched closed loops
+      (both lanes continuously backlogged), so completions split by
+      DRR weight.  ``tenant_fair_share_error`` = |observed victim
+      share - 4/5|, noise-floored at 0.05 for the percent-delta
+      regression gate (exact weighted fairness reads as the floor).
+
+    Exits nonzero on any steady-state recompile, any victim
+    error/shed (its lane must stay clean while the flood sheds), or a
+    flood 5xx (overload must shed 429, not error)."""
+    from sat_tpu import telemetry
+    from sat_tpu.serve.engine import ServeEngine, load_serving_state
+    from sat_tpu.serve.server import CaptionServer
+
+    config, vocabulary, tel = _make_ckpt(args, workdir)
+    registry = os.path.join(workdir, "tenants.json")
+    weights = {"victim": 4.0, "peer": 1.0, "flood": 1.0}
+    with open(registry, "w") as f:
+        json.dump({
+            "default": "victim",
+            "tenants": [
+                {"name": "victim", "weight": weights["victim"]},
+                {"name": "peer", "weight": weights["peer"]},
+                {"name": "flood", "weight": weights["flood"],
+                 "rps": args.tenant_flood_rps,
+                 "burst": 2.0 * args.tenant_flood_rps},
+            ],
+        }, f)
+    config = config.replace(
+        serve_mode="continuous",
+        serve_slot_pages=args.slot_pages,
+        serve_page_width=args.page_width,
+        tenants=registry,
+    )
+    state, _ = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+    server = CaptionServer(config, engine, port=0).start()
+    try:
+        port = server.port
+        jpegs = _make_jpegs(8, config.image_size)
+        log(f"tenant server up on port {port} (slot pool "
+            f"{args.slot_pages}x{args.page_width}, weights {weights}, "
+            f"flood quota {args.tenant_flood_rps} rps)")
+        _post(port, jpegs[0])  # warm pass (first-touch host costs)
+        compiles0 = tel.counters().get("jax/compiles", 0)
+
+        vic = {"X-Tenant": "victim"}
+        alone = open_loop(port, jpegs, args.tenant_rate,
+                          args.tenant_requests, headers=vic)
+        log(f"victim alone @ {args.tenant_rate}/s: {alone['ok']} ok "
+            f"(p50 {alone['p50']}ms p99 {alone['p99']}ms)")
+
+        # flood offers several times its quota for the WHOLE victim arm:
+        # an open-loop driver (fire-and-forget threads, like open_loop)
+        # so slow admitted requests never self-throttle the offered rate
+        stop = threading.Event()
+        flood_codes, flock = [], threading.Lock()
+
+        def flood_fire():
+            status, _lat = _post(port, jpegs[0],
+                                 headers={"X-Tenant": "flood"})
+            with flock:
+                flood_codes.append(status)
+
+        def flood_driver():
+            rng = random.Random(7)
+            while not stop.is_set():
+                time.sleep(rng.expovariate(args.tenant_flood_rate))
+                threading.Thread(target=flood_fire, daemon=True).start()
+
+        driver = threading.Thread(target=flood_driver, daemon=True)
+        driver.start()
+        under = open_loop(port, jpegs, args.tenant_rate,
+                          args.tenant_requests, headers=vic)
+        stop.set()
+        driver.join(timeout=60)
+        time.sleep(2.0)  # let in-flight flood requests land
+        with flock:
+            flood_shed = sum(1 for c in flood_codes if c == 429)
+            flood_5xx = sum(1 for c in flood_codes if c == 0 or c >= 500)
+            flood_total = len(flood_codes)
+        raw_ratio = (
+            under["p99"] / alone["p99"] if alone["p99"] else 0.0
+        )
+        # noise-floored like the fair-share row: tail-over-tail on a
+        # shared CPU host swings 1.1-2.5x run to run, which a
+        # percent-delta gate would misread as a regression.  Ratios
+        # under the floor are healthy isolation; a broken plane (no
+        # quota, no DRR weighting) reads 4-11x and clears it by far
+        ratio = round(max(raw_ratio, 3.0), 3)
+        log(f"victim under flood @ {args.tenant_rate}/s: {under['ok']} ok, "
+            f"{under['shed']} shed (p99 {under['p99']}ms vs "
+            f"{alone['p99']}ms alone -> raw ratio {raw_ratio:.3f}, "
+            f"floored {ratio}); flood: "
+            f"{flood_total} offered, {flood_shed} shed, "
+            f"{flood_5xx} 5xx")
+
+        # fair share: a time-boxed contended interval.  Fixed-size
+        # closed loops can't measure fairness (every loop completes all
+        # its requests eventually — the split is 50/50 by construction);
+        # instead both lanes run enough blocking clients to stay
+        # backlogged for the same wall-clock window, and DRR splits the
+        # completions by weight
+        share_stop = threading.Event()
+        share_ok = {"victim": 0, "peer": 0}
+        share_lock = threading.Lock()
+
+        def share_worker(tenant, wid):
+            while not share_stop.is_set():
+                status, _lat = _post(port, jpegs[wid % len(jpegs)],
+                                     headers={"X-Tenant": tenant})
+                if status == 200 and not share_stop.is_set():
+                    with share_lock:
+                        share_ok[tenant] += 1
+
+        workers = [
+            threading.Thread(target=share_worker, args=(t, w), daemon=True)
+            for t in ("victim", "peer")
+            for w in range(args.tenant_concurrency)
+        ]
+        for t in workers:
+            t.start()
+        time.sleep(args.tenant_share_seconds)
+        share_stop.set()
+        for t in workers:
+            t.join(timeout=120)
+        expected = weights["victim"] / (weights["victim"] + weights["peer"])
+        total_ok = share_ok["victim"] + share_ok["peer"]
+        observed = share_ok["victim"] / total_ok if total_ok else 0.0
+        raw_err = abs(observed - expected)
+        # noise-floored for the regression gate: the gate compares
+        # percent deltas, and a near-zero baseline would turn count
+        # jitter (0.01 -> 0.03) into a fake 200% regression.  Errors
+        # under the floor are indistinguishable from scheduling noise;
+        # real unfairness (a broken DRR reads ~0.2+) clears it by far
+        share_err = round(max(raw_err, 0.05), 4)
+        log(f"fair share over {args.tenant_share_seconds}s contended: "
+            f"victim {share_ok['victim']} ok vs peer {share_ok['peer']} "
+            f"ok -> observed share {observed:.3f} (weighted target "
+            f"{expected:.3f}, error {share_err})")
+
+        recompiles = tel.counters().get("jax/compiles", 0) - compiles0
+        victim_bad = (
+            alone["errors"] + under["errors"] + under["shed"]
+            + alone["shed"]
+        )
+        log(f"steady-state XLA compiles during tenant load: {recompiles}")
+
+        common = {
+            "weights": weights,
+            "flood_quota_rps": args.tenant_flood_rps,
+            "flood_offered_rate_per_s": args.tenant_flood_rate,
+            "victim_rate_per_s": args.tenant_rate,
+            "victim_arrivals_per_arm": args.tenant_requests,
+            "slot_pages": args.slot_pages,
+            "page_width": args.page_width,
+            "steady_state_compiles": recompiles,
+            **telemetry.bench_stamp(),
+        }
+        print(json.dumps({
+            "metric": "tenant_isolation_p99_ratio",
+            "value": ratio,
+            "unit": "ratio",
+            "victim_alone_p99_ms": alone["p99"],
+            "victim_under_flood_p99_ms": under["p99"],
+            "raw_p99_ratio": round(raw_ratio, 3),
+            "noise_floor": 3.0,
+            "victim_alone_p50_ms": alone["p50"],
+            "victim_under_flood_p50_ms": under["p50"],
+            "victim_errors": victim_bad,
+            "flood_offered": flood_total,
+            "flood_shed": flood_shed,
+            "flood_5xx": flood_5xx,
+            **common,
+        }), flush=True)
+        print(json.dumps({
+            "metric": "tenant_fair_share_error",
+            "value": share_err,
+            "unit": "fraction",
+            "observed_victim_share": round(observed, 4),
+            "expected_victim_share": round(expected, 4),
+            "raw_share_error": round(raw_err, 4),
+            "noise_floor": 0.05,
+            "victim_completed": share_ok["victim"],
+            "peer_completed": share_ok["peer"],
+            "contended_seconds": args.tenant_share_seconds,
+            "clients_per_tenant": args.tenant_concurrency,
+            **common,
+        }), flush=True)
+        ok = recompiles == 0 and victim_bad == 0 and flood_5xx == 0
+        if not ok:
+            log(f"FAIL: isolation invariant violated "
+                f"(recompiles={recompiles}, victim_bad={victim_bad}, "
+                f"flood_5xx={flood_5xx})")
+        return 0 if ok else 1
+    finally:
+        _CLIENT.close_all()
+        server.shutdown()
 
 
 def _post_admin(port, action, timeout=240.0):
@@ -769,6 +998,35 @@ def main() -> int:
                          "goodput scales with fleet size even when all "
                          "replicas share this host's CPUs; 0 disables "
                          "and measures raw CPU-decode contention")
+    ap.add_argument("--tenants", action="store_true",
+                    help="tenant mode: per-tenant SLO isolation + DRR "
+                         "fair-share on one continuous-mode server "
+                         "(tenant_isolation_p99_ratio / "
+                         "tenant_fair_share_error rows; exit 1 on any "
+                         "recompile, victim-lane shed/error or flood 5xx)")
+    ap.add_argument("--tenant-rate", type=float, default=6.0,
+                    help="tenant mode: victim open-loop Poisson rate for "
+                         "the alone and under-flood arms")
+    ap.add_argument("--tenant-requests", type=int, default=80,
+                    help="tenant mode: victim arrivals per arm")
+    ap.add_argument("--tenant-flood-rate", type=float, default=30.0,
+                    help="tenant mode: offered flood rate, several times "
+                         "the flood tenant's admission quota")
+    ap.add_argument("--tenant-flood-rps", type=float, default=1.0,
+                    help="tenant mode: the flood tenant's token-bucket "
+                         "quota (rps; burst = 2x).  Small relative to "
+                         "the box's capacity: the admitted remainder is "
+                         "the flood's LEGAL share, and the isolation "
+                         "ratio should price only that")
+    ap.add_argument("--tenant-concurrency", type=int, default=18,
+                    help="tenant mode: blocking clients PER TENANT in "
+                         "the fair-share phase — must exceed the "
+                         "victim's weighted share of the slot pool, or "
+                         "its lane drains and work-conservation hands "
+                         "the peer extra seats")
+    ap.add_argument("--tenant-share-seconds", type=float, default=12.0,
+                    help="tenant mode: wall-clock length of the "
+                         "fair-share contended window")
     ap.add_argument("--lifecycle", action="store_true",
                     help="lifecycle mode: a full reload -> canary -> "
                          "promote cycle on a live continuous-mode server "
@@ -787,10 +1045,12 @@ def main() -> int:
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="bench_serve_")
     made_workdir = args.workdir is None
-    if args.fleet or args.lifecycle:
+    if args.fleet or args.lifecycle or args.tenants:
         try:
             if args.fleet:
                 return fleet_bench(args, workdir)
+            if args.tenants:
+                return tenants_bench(args, workdir)
             return lifecycle_bench(args, workdir)
         finally:
             if made_workdir:

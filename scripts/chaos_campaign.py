@@ -1291,6 +1291,243 @@ def lifecycle_canary_rollback(ctx: Ctx):
             "why": last.get("why", "")[:80]}
 
 
+# The multi-tenant isolation rehearsal (ISSUE 17 acceptance): tenant A
+# floods at ~5x its admission quota while tenant B sends steady traffic.
+# B's latency must hold, A must see only tenant-scoped 429s (never 5xx),
+# steady state must not recompile, and A's SLO lane burns while B's
+# stays green.
+_TENANT_FLOOD_CHILD = r'''
+import json, os, sys, threading, time, urllib.error, urllib.request
+
+import cv2
+import jax
+import numpy as np
+
+from sat_tpu import runtime, telemetry
+from sat_tpu.config import Config
+from sat_tpu.data.vocabulary import Vocabulary
+from sat_tpu.resilience import lineage
+from sat_tpu.serve.engine import ServeEngine, load_serving_state
+from sat_tpu.serve.server import CaptionServer
+from sat_tpu.train.checkpoint import save_checkpoint
+from sat_tpu.train.step import create_train_state
+
+workdir = sys.argv[1]
+vocab_file = os.path.join(workdir, "vocabulary.csv")
+vocabulary = Vocabulary(size=30)
+vocabulary.build(["a man riding a horse.", "a cat on a table."])
+vocabulary.save(vocab_file)
+
+# two-tenant registry: "steady" (weight 4, unlimited, roomy SLO) is the
+# default; "flood" (weight 1, 6 rps / burst 3) gets a tight latency
+# lane its own queueing will burn while it floods
+registry = os.path.join(workdir, "tenants.json")
+with open(registry, "w") as f:
+    json.dump({
+        "default": "steady",
+        "tenants": [
+            {"name": "steady", "weight": 4.0, "slo_p99_ms": 60000.0},
+            {"name": "flood", "weight": 1.0, "rps": 6.0, "burst": 3.0,
+             "slo_p99_ms": 40.0},
+        ],
+    }, f)
+
+config = Config(
+    phase="serve", image_size=32, dim_embedding=16, num_lstm_units=16,
+    dim_initialize_layer=16, dim_attend_layer=16, dim_decode_layer=32,
+    compute_dtype="float32", vocabulary_size=vocabulary.size,
+    vocabulary_file=vocab_file, beam_size=2,
+    save_dir=os.path.join(workdir, "models"),
+    summary_dir=os.path.join(workdir, "summary"),
+    serve_mode="continuous", serve_slot_pages=2, serve_page_width=2,
+    serve_queue_depth=16, tenants=registry,
+    slo_window_fast_s=1.5, slo_window_slow_s=3.0,
+    heartbeat_interval=0.0,
+)
+os.makedirs(config.save_dir, exist_ok=True)
+tel = telemetry.enable(capacity=16384)
+runtime._install_compile_listener()
+state = create_train_state(jax.random.PRNGKey(0), config)
+save_checkpoint(state, config)
+lineage.mark_last_good(config.save_dir, int(np.asarray(state.step)))
+state, _ = load_serving_state(config)
+engine = ServeEngine(config, state, vocabulary, tel=tel)
+engine.warmup()
+server = CaptionServer(config, engine, port=0).start()
+port = server.port
+
+img = np.random.default_rng(0).integers(0, 255, (32, 32, 3), dtype=np.uint8)
+ok, buf = cv2.imencode(".jpg", img)
+jpeg = bytes(buf)
+
+
+def post(tenant, timeout=90.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/caption", data=jpeg, method="POST",
+        headers={"Content-Type": "image/jpeg", "X-Tenant": tenant})
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            body = json.loads(r.read())
+            return (r.status, (time.perf_counter() - t0) * 1e3,
+                    body, dict(r.headers))
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read())
+        return (e.code, (time.perf_counter() - t0) * 1e3,
+                body, dict(e.headers))
+
+
+def get(route):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{route}", timeout=10) as r:
+        return r.status, r.read()
+
+
+def p99(vals):
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+
+# phase A: steady alone — the isolation baseline
+alone_ms = []
+for _ in range(12):
+    status, ms, body, _h = post("steady")
+    assert status == 200, (status, body)
+    alone_ms.append(ms)
+compiles0 = tel.counters().get("jax/compiles", 0)
+
+# phase B: flood hammers ~5x its quota while steady keeps its cadence
+stop = threading.Event()
+flood_out, lock = [], threading.Lock()
+
+
+def flood_loop():
+    while not stop.is_set():
+        status, ms, body, headers = post("flood")
+        with lock:
+            flood_out.append(
+                (status, body.get("shed_scope"),
+                 headers.get("X-Shed-Scope"), headers.get("Retry-After")))
+        time.sleep(0.01)
+
+
+threads = [threading.Thread(target=flood_loop, daemon=True)
+           for _ in range(3)]
+for t in threads:
+    t.start()
+under_ms, steady_bad = [], []
+for _ in range(12):
+    status, ms, body, _h = post("steady")
+    if status != 200:
+        steady_bad.append((status, body))
+    under_ms.append(ms)
+
+# keep the flood RUNNING while the SLO engine ticks: the burn windows
+# (fast 1.5s / slow 3.0s) only score live spans — stopping the flood
+# first would age them out of the fast window before any tick saw them
+flood_burning = 0
+deadline = time.monotonic() + 25.0
+while time.monotonic() < deadline and not flood_burning:
+    if tel.gauges().get("slo/tenant_flood_p99_ms_burning") == 1:
+        flood_burning = 1
+    else:
+        time.sleep(0.25)
+gauges = tel.gauges()
+# health is probed AT the burn moment: a tenant-lane burn must not
+# flip the replica's fleet-facing health
+health_status = json.loads(get("/healthz")[1]).get("status")
+stop.set()
+for t in threads:
+    t.join(timeout=60)
+counters = tel.counters()
+_s, stats_raw = get("/stats")
+stats = json.loads(stats_raw)
+_s, metrics_raw = get("/metrics")
+result = {
+    "alone_p99_ms": round(p99(alone_ms), 1),
+    "under_p99_ms": round(p99(under_ms), 1),
+    "steady_bad": steady_bad,
+    "flood_total": len(flood_out),
+    "flood_statuses": sorted({s for s, *_ in flood_out}),
+    "flood_shed": sum(1 for s, *_ in flood_out if s == 429),
+    "flood_5xx": sum(1 for s, *_ in flood_out if s >= 500),
+    "non_tenant_sheds": [
+        r for r in flood_out
+        if r[0] == 429 and (r[1] != "tenant" or r[2] != "tenant")
+    ][:5],
+    "zero_retry_after": sum(
+        1 for s, _sc, _h, ra in flood_out
+        if s == 429 and (not ra or int(ra) < 1)),
+    "compile_delta": tel.counters().get("jax/compiles", 0) - compiles0,
+    "flood_burning": flood_burning,
+    "steady_burning": gauges.get("slo/tenant_steady_p99_ms_burning", 0),
+    "flood_shed_counter": counters.get("serve/tenant_flood_shed", 0),
+    "stats_tenants": sorted((stats.get("tenants") or {}).keys()),
+    "metrics_has_tenant": b"serve/tenant_flood_shed" in metrics_raw,
+    "health_status": health_status,
+}
+server.shutdown()
+print(json.dumps(result))
+'''
+
+
+@scenario
+def tenant_flood_isolation(ctx: Ctx):
+    """ISSUE 17 acceptance: tenant A floods at ~5x its token-bucket
+    quota while tenant B sends steady traffic through the same
+    continuous-mode server.  B's p99 holds within margin of its
+    flood-free baseline, A sees only tenant-scoped 429s (X-Shed-Scope:
+    tenant, Retry-After >= 1, never a 5xx), steady state never
+    recompiles, and A's SLO lane burns while B's stays green — without
+    flipping the replica's fleet-facing health."""
+    workdir = os.path.join(ctx.root, "tenant_flood")
+    os.makedirs(workdir, exist_ok=True)
+    proc = subprocess.run(
+        [sys.executable, "-c", _TENANT_FLOOD_CHILD, workdir],
+        capture_output=True, text=True, cwd=REPO,
+        env=_child_env(), timeout=_TIMEOUT,
+    )
+    check(proc.returncode == 0,
+          f"tenant flood child rc {proc.returncode}\n"
+          f"{proc.stdout}\n{proc.stderr}")
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    check(result["steady_bad"] == [],
+          f"steady tenant was not isolated: {result['steady_bad']}")
+    margin = max(5.0 * result["alone_p99_ms"],
+                 result["alone_p99_ms"] + 2000.0)
+    check(result["under_p99_ms"] <= margin,
+          f"steady p99 blew out under flood: {result['under_p99_ms']}ms "
+          f"vs {result['alone_p99_ms']}ms alone (margin {margin:.0f}ms)")
+    check(result["flood_5xx"] == 0,
+          f"flood tenant saw {result['flood_5xx']} 5xx — overload must "
+          "shed, not error")
+    check(result["flood_shed"] >= 1,
+          f"flood at 5x quota was never shed: {result['flood_statuses']}")
+    check(set(result["flood_statuses"]) <= {200, 429},
+          f"unexpected flood statuses: {result['flood_statuses']}")
+    check(result["non_tenant_sheds"] == [],
+          f"sheds without tenant scope: {result['non_tenant_sheds']}")
+    check(result["zero_retry_after"] == 0,
+          f"{result['zero_retry_after']} sheds carried a Retry-After < 1s")
+    check(result["compile_delta"] == 0,
+          f"steady state recompiled under flood: {result['compile_delta']}")
+    check(result["flood_burning"] == 1,
+          f"flood tenant's SLO lane never burned: "
+          f"{result['flood_burning']}")
+    check(result["steady_burning"] == 0,
+          f"steady tenant's SLO lane burned: {result['steady_burning']}")
+    check(result["health_status"] == "ok",
+          f"a tenant-lane burn degraded the replica's fleet-facing "
+          f"health: {result['health_status']!r}")
+    check(result["flood_shed_counter"] >= 1
+          and result["stats_tenants"] == ["flood", "steady"]
+          and result["metrics_has_tenant"],
+          "per-tenant counters missing from /stats+/metrics")
+    return {k: result[k] for k in
+            ("alone_p99_ms", "under_p99_ms", "flood_total", "flood_shed",
+             "compile_delta")}
+
+
 # -- orchestration ----------------------------------------------------------
 
 

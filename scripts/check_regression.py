@@ -115,6 +115,12 @@ DEFAULT_MARGINS = {
     # open-loop p50s — both wall-clock-noisy families, wide margins
     "swap_blackout_ms": 25.0,
     "canary_overhead_pct": 25.0,
+    # multi-tenant rows (docs/SERVING.md "Multi-tenant serving"): the
+    # isolation ratio divides two open-loop p99s on a shared CPU host
+    # (tail-over-tail — the noisiest shape we gate); fair-share error is
+    # a completion-count fraction over a fixed window, much steadier
+    "tenant_isolation_p99_ratio": 30.0,
+    "tenant_fair_share_error": 25.0,
 }
 FALLBACK_MARGIN = 5.0
 
@@ -136,6 +142,8 @@ _LOWER_BETTER_EXACT = {
     "serve_admission_latency_ms",
     "quant_ctx_rel_err",
     "quant_logit_drift",
+    "tenant_isolation_p99_ratio",
+    "tenant_fair_share_error",
 }
 # explicitly HIGHER-better (checked first — "per_sec" would otherwise
 # trip the "_s" suffix heuristic below)

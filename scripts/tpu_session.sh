@@ -149,6 +149,19 @@ if [ "$rc" -ne 0 ] || [ ! -s "$OUT/lifecycle_serve.json" ]; then
   FAILED="$FAILED lifecycle_serve"
 fi
 
+echo "=== stage 1j: multi-tenant serve (SLO isolation + DRR fair share) ==="
+# one continuous-mode server with a victim/peer/flood registry: victim
+# p99 under a 5x-quota flood vs alone, then a contended fair-share
+# window; exits nonzero on any steady-state recompile, victim-lane
+# shed/error or flood 5xx
+timeout 900 python scripts/bench_serve.py --tenants \
+  2>"$OUT/tenant_serve.log" | tee "$OUT/tenant_serve.json"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/tenant_serve.json" ]; then
+  echo "STAGE FAILED: tenant_serve (rc=$rc) — see $OUT/tenant_serve.log"
+  FAILED="$FAILED tenant_serve"
+fi
+
 echo "=== stage 2: pallas attention measurement ==="
 timeout 1800 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
 rc=${PIPESTATUS[0]}

@@ -385,10 +385,19 @@ def test_fleet_router_is_jax_free():
         "import sys\n"
         "assert 'jax' not in sys.modules\n"
         "import sat_tpu.serve\n"
-        "from sat_tpu.serve import replica, router\n"
+        "from sat_tpu.serve import replica, router, scheduler, tenants\n"
         "router.replica_weight(True, False, 0.25)\n"
         "replica.parse_endpoints('127.0.0.1:8710,127.0.0.1:8711')\n"
-        "assert 'jax' not in sys.modules, 'router/replica pulled in jax'\n"
+        # the multi-tenant plane (registry + DRR scheduler) rides the
+        # router process too — parse, admit, and schedule without jax
+        "reg = tenants.TenantRegistry.parse('a:4:10,b:1')\n"
+        "assert reg.multi and reg.try_admit('a')\n"
+        "drr = scheduler.DeficitRoundRobin(maxsize=2, weights=reg.weights())\n"
+        "class _I:\n"
+        "    tenant = 'b'\n"
+        "drr.put_nowait(_I())\n"
+        "assert drr.get_nowait().tenant == 'b'\n"
+        "assert 'jax' not in sys.modules, 'router/replica/tenants pulled in jax'\n"
         "sat_tpu.serve.Rejected\n"
         "assert 'jax' in sys.modules, 'lazy engine-side export broken'\n"
     )

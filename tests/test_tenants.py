@@ -1,0 +1,578 @@
+"""Multi-tenant serving plane tests (docs/SERVING.md "Multi-tenant
+serving").
+
+Pins the ISSUE 17 contracts:
+
+* deficit-round-robin scheduling: exact weight proportionality under
+  contention, work-conserving idle borrowing, starvation-freedom under
+  adversarial arrival, tenant-scoped queue bounds, and exact-FIFO
+  degeneration for the single-tenant case;
+* token-bucket admission: burst/capacity edges, refill across a drain
+  (injectable clock), unlimited tenants;
+* registry parsing/validation: inline + JSON forms, CLI round-trip,
+  duplicate/unknown rejection, SLO-lane inheritance;
+* the HTTP plane end-to-end on CPU: X-Tenant routing, tenant-scoped
+  429s (X-Shed-Scope + never-0s Retry-After), per-tenant /stats +
+  /metrics blocks — and the acceptance pins: the default tenant's
+  captions are bitwise-identical to a no-``--tenants`` server, and a
+  second resident model serves with ZERO new compiles (params are
+  runtime args of the warmed executables).
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sat_tpu.serve.scheduler import DeficitRoundRobin
+from sat_tpu.serve.tenants import TenantRegistry, TenantSpec, TokenBucket
+
+
+class _Item:
+    def __init__(self, tenant=None, tag=0):
+        if tenant is not None:
+            self.tenant = tenant
+        self.tag = tag
+
+
+# ---------------------------------------------------------------------------
+# Deficit round robin (pure, jax-free)
+# ---------------------------------------------------------------------------
+
+
+class TestDeficitRoundRobin:
+    def test_single_tenant_is_exact_fifo(self):
+        q = DeficitRoundRobin(maxsize=0)
+        for i in range(20):
+            q.put_nowait(_Item(tag=i))
+        assert [q.get_nowait().tag for i in range(20)] == list(range(20))
+        with pytest.raises(queue.Empty):
+            q.get_nowait()
+
+    def test_missing_tenant_attr_rides_default_lane(self):
+        q = DeficitRoundRobin()
+        q.put_nowait(_Item(tag=1))  # no .tenant at all
+        q.put_nowait(_Item(tenant="default", tag=2))
+        assert [q.get_nowait().tag for _ in range(2)] == [1, 2]
+
+    def test_weight_proportionality_under_contention(self):
+        """Weights 3:1 with both lanes saturated: pops split exactly
+        3:1 — the flooding lane cannot exceed its share."""
+        q = DeficitRoundRobin(weights={"a": 3.0, "b": 1.0})
+        for i in range(60):
+            q.put_nowait(_Item("a", i))
+            q.put_nowait(_Item("b", i))
+        got = [q.get_nowait().tenant for _ in range(40)]
+        assert got.count("a") == 30 and got.count("b") == 10
+
+    def test_within_lane_order_is_fifo(self):
+        q = DeficitRoundRobin(weights={"a": 2.0, "b": 1.0})
+        for i in range(10):
+            q.put_nowait(_Item("a", i))
+            q.put_nowait(_Item("b", 100 + i))
+        by_lane = {"a": [], "b": []}
+        while True:
+            try:
+                item = q.get_nowait()
+            except queue.Empty:
+                break
+            by_lane[item.tenant].append(item.tag)
+        assert by_lane["a"] == list(range(10))
+        assert by_lane["b"] == [100 + i for i in range(10)]
+
+    def test_work_conserving_idle_borrow(self):
+        """A low-weight lane alone drains at full speed — nothing is
+        reserved for tenants with no queued work."""
+        q = DeficitRoundRobin(weights={"vip": 100.0, "small": 0.5})
+        for i in range(30):
+            q.put_nowait(_Item("small", i))
+        assert [q.get_nowait().tag for _ in range(30)] == list(range(30))
+
+    def test_starvation_freedom_adversarial(self):
+        """An epsilon-weight tenant against a 100x flooder still pops
+        within its guaranteed ceil(1/weight) rotations."""
+        q = DeficitRoundRobin(weights={"flood": 100.0, "tiny": 0.1})
+        q.put_nowait(_Item("tiny", 0))
+        for i in range(5000):
+            q.put_nowait(_Item("flood", i))
+        # tiny gains 0.1 deficit per rotation: a unit by rotation 10,
+        # during which flood pops at most 100 per visit
+        first_tiny = next(
+            i for i in range(2000) if q.get_nowait().tenant == "tiny"
+        )
+        assert first_tiny <= 1001  # 10 rotations x 100 + the tiny pop
+
+    def test_tenant_scoped_maxsize(self):
+        """One tenant's backlog fills ITS lane only; the other still
+        enqueues — the bound that makes queue-full a tenant-scoped
+        shed."""
+        q = DeficitRoundRobin(maxsize=2, weights={"a": 1.0, "b": 1.0})
+        q.put_nowait(_Item("a", 0))
+        q.put_nowait(_Item("a", 1))
+        with pytest.raises(queue.Full):
+            q.put_nowait(_Item("a", 2))
+        q.put_nowait(_Item("b", 0))  # unaffected lane
+        assert q.qsize() == 3
+        assert q.depths() == {"a": 2, "b": 1}
+
+    def test_deficit_resets_when_lane_empties(self):
+        """No banking across idle: an emptied lane re-enters the
+        rotation at deficit 0 like everyone else."""
+        q = DeficitRoundRobin(weights={"a": 5.0, "b": 1.0})
+        q.put_nowait(_Item("a", 0))
+        q.get_nowait()
+        assert q._deficit["a"] == 0.0
+
+    def test_blocking_get_timeout_and_wakeup(self):
+        q = DeficitRoundRobin()
+        t0 = time.monotonic()
+        with pytest.raises(queue.Empty):
+            q.get(timeout=0.05)
+        assert time.monotonic() - t0 >= 0.04
+        got = []
+
+        def consumer():
+            got.append(q.get(timeout=5.0).tag)
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        q.put_nowait(_Item(tag=7))
+        t.join(timeout=5.0)
+        assert got == [7]
+
+    def test_drain_all_and_invalid_weight(self):
+        q = DeficitRoundRobin(weights={"a": 2.0, "b": 1.0})
+        for i in range(4):
+            q.put_nowait(_Item("a" if i % 2 else "b", i))
+        assert len(q.drain_all()) == 4 and q.qsize() == 0
+        with pytest.raises(ValueError):
+            DeficitRoundRobin(weights={"a": 0.0})
+
+
+# ---------------------------------------------------------------------------
+# Token bucket + specs
+# ---------------------------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_unlimited_rate_always_admits(self):
+        b = TokenBucket(rate=0.0, capacity=0.0)
+        assert all(b.try_take() for _ in range(1000))
+        assert b.retry_after_s() == 0.0
+
+    def test_capacity_default_when_burst_unset(self):
+        assert TenantSpec(name="a", rps=0.5).capacity == 1.0
+        assert TenantSpec(name="a", rps=5.0).capacity == 5.0
+        assert TenantSpec(name="a", rps=5.0, burst=2.0).capacity == 2.0
+        assert not TenantSpec(name="a").limited
+
+    def test_refill_across_drain_with_injectable_clock(self):
+        now = [0.0]
+        b = TokenBucket(rate=2.0, capacity=4.0, clock=lambda: now[0])
+        assert all(b.try_take() for _ in range(4))  # burst drains
+        assert not b.try_take()
+        assert b.retry_after_s() == pytest.approx(0.5)
+        now[0] = 0.25  # half a token back: still dry
+        assert not b.try_take()
+        now[0] = 0.51
+        assert b.try_take()  # one token refilled
+        assert not b.try_take()
+        now[0] = 100.0  # refill clamps at capacity, not 200 tokens
+        assert sum(b.try_take() for _ in range(10)) == 4
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            TenantSpec(name="a", weight=0.0)
+        with pytest.raises(ValueError):
+            TenantSpec(name="a", rps=-1.0)
+        with pytest.raises(ValueError):
+            TenantSpec(name="bad name!")
+
+
+# ---------------------------------------------------------------------------
+# Registry parsing + validation
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_empty_spec_is_degenerate_single_tenant(self):
+        reg = TenantRegistry.parse("")
+        assert not reg.multi
+        assert reg.resolve(None).name == "default"
+        assert reg.try_admit("default")
+        assert reg.weights() == {"default": 1.0}
+        assert reg.slo_lanes(100.0, 0.1) == []
+
+    def test_inline_round_trip(self):
+        reg = TenantRegistry.parse("alpha:4:10:20, beta, gamma:0.5")
+        assert reg.multi and reg.default == "alpha"
+        assert reg.weights() == {"alpha": 4.0, "beta": 1.0, "gamma": 0.5}
+        assert reg.get("alpha").rps == 10.0
+        assert reg.get("alpha").capacity == 20.0
+        assert reg.resolve("beta").name == "beta"
+        assert reg.resolve("nosuch").name == "alpha"  # default, not a 404
+        assert reg.resolve(None).name == "alpha"
+        assert not reg.known("nosuch") and reg.known("gamma")
+
+    def test_cli_round_trip(self):
+        from sat_tpu.cli import build_config
+
+        config, _cli = build_config(
+            ["--phase=serve", "--port=0", "--tenants", "a:2:5,b:1"]
+        )
+        assert config.tenants == "a:2:5,b:1"
+        reg = TenantRegistry.parse(config.tenants)
+        assert reg.weights() == {"a": 2.0, "b": 1.0}
+        assert reg.get("a").rps == 5.0
+
+    def test_json_doc_with_models_and_slo(self, tmp_path):
+        path = str(tmp_path / "tenants.json")
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "default": "big",
+                    "models": {"v2": "/ckpts/100.npz"},
+                    "tenants": [
+                        {"name": "big", "weight": 4.0,
+                         "slo_p99_ms": 250.0},
+                        {"name": "small", "weight": 1.0, "rps": 2.0,
+                         "model": "v2"},
+                    ],
+                },
+                f,
+            )
+        reg = TenantRegistry.parse(path)
+        assert reg.default == "big" and reg.models == {"v2": "/ckpts/100.npz"}
+        assert reg.get("small").model == "v2"
+        # SLO lanes: declared target wins, defaults inherited otherwise
+        lanes = reg.slo_lanes(900.0, 0.25)
+        assert ("big", 250.0, 0.25) in lanes
+        assert ("small", 900.0, 0.25) in lanes
+
+    def test_validation_rejects(self, tmp_path):
+        with pytest.raises(ValueError):
+            TenantRegistry.parse("a,a")  # duplicate
+        with pytest.raises(ValueError):
+            TenantRegistry.parse("a:0")  # weight <= 0
+        with pytest.raises(ValueError):
+            TenantRegistry.parse("a:1:2:3:4")  # too many fields
+        with pytest.raises(ValueError):
+            TenantRegistry.parse("a:x")  # non-numeric
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            json.dump({"tenants": [{"name": "a", "quota": 5}]}, f)
+        with pytest.raises(ValueError):
+            TenantRegistry.parse(bad)  # unknown key
+        missing_model = str(tmp_path / "missing_model.json")
+        with open(missing_model, "w") as f:
+            json.dump({"tenants": [{"name": "a", "model": "ghost"}]}, f)
+        with pytest.raises(ValueError):
+            TenantRegistry.parse(missing_model)
+        bad_default = str(tmp_path / "bad_default.json")
+        with open(bad_default, "w") as f:
+            json.dump({"default": "ghost", "tenants": [{"name": "a"}]}, f)
+        with pytest.raises(ValueError):
+            TenantRegistry.parse(bad_default)
+
+    def test_quota_and_retry_surface(self):
+        now = [0.0]
+        reg = TenantRegistry.parse("a:1,b:1:2:2", clock=lambda: now[0])
+        assert reg.tokens("a") is None  # unlimited
+        assert reg.try_admit("b") and reg.try_admit("b")
+        assert not reg.try_admit("b")
+        assert reg.retry_after_s("b") == pytest.approx(0.5)
+        assert reg.retry_after_s("a") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP end-to-end (CPU): parity, quota contract, resident models
+# ---------------------------------------------------------------------------
+
+
+TINY_MODEL = dict(
+    phase="serve",
+    image_size=32,
+    dim_embedding=16,
+    num_lstm_units=16,
+    dim_initialize_layer=16,
+    dim_attend_layer=16,
+    dim_decode_layer=32,
+    compute_dtype="float32",
+    beam_size=2,
+    serve_buckets=(1, 2),
+    serve_max_batch=2,
+    serve_max_wait_ms=10.0,
+    serve_queue_depth=8,
+    heartbeat_interval=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """Warmed batch-mode ServeEngine from a synthetic checkpoint (no
+    training run), plus a second jittered checkpoint for the resident
+    tests.  Servers are booted per-test against this shared engine."""
+    import cv2
+    import jax
+
+    from sat_tpu import runtime, telemetry
+    from sat_tpu.config import Config
+    from sat_tpu.data.vocabulary import Vocabulary, vocab_fingerprint
+    from sat_tpu.resilience import lineage
+    from sat_tpu.serve.engine import ServeEngine, load_serving_state
+    from sat_tpu.train.checkpoint import save_checkpoint
+    from sat_tpu.train.step import create_train_state
+
+    root = str(tmp_path_factory.mktemp("tenants"))
+    vocab_file = os.path.join(root, "vocabulary.csv")
+    vocabulary = Vocabulary(size=30)
+    vocabulary.build(["a man riding a horse.", "a cat on a table."])
+    vocabulary.save(vocab_file)
+    config = Config(
+        **TINY_MODEL,
+        vocabulary_size=vocabulary.size,
+        vocabulary_file=vocab_file,
+        save_dir=os.path.join(root, "models"),
+        summary_dir=os.path.join(root, "summary"),
+    )
+    os.makedirs(config.save_dir, exist_ok=True)
+    tel = telemetry.enable(capacity=16384)
+    runtime._install_compile_listener()
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    save_checkpoint(state, config)
+    base_step = int(np.asarray(state.step))
+    lineage.mark_last_good(config.save_dir, base_step)
+
+    # a second model generation for the resident tests: same avals,
+    # nudged decoder params, attested sidecar (what a retrain publishes)
+    flat = dict(
+        np.load(os.path.join(config.save_dir, f"{base_step}.npz"))
+    )
+    for k in list(flat):
+        if k.startswith("params/decoder/") and flat[k].dtype.kind == "f":
+            flat[k] = flat[k] + np.asarray(1e-3, flat[k].dtype)
+    flat["global_step"] = np.asarray(base_step + 100, np.int64)
+    ckpt_v2 = os.path.join(config.save_dir, f"{base_step + 100}.npz")
+    with open(ckpt_v2, "wb") as f:
+        np.savez(f, **flat)
+    lineage.write_sidecar(
+        ckpt_v2,
+        vocab=vocab_fingerprint(config.vocabulary_file,
+                                config.vocabulary_size),
+    )
+
+    state, _source = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+
+    img = np.random.default_rng(0).integers(
+        0, 255, (32, 32, 3), dtype=np.uint8
+    )
+    ok, buf = cv2.imencode(".jpg", img)
+    assert ok
+    yield {
+        "config": config,
+        "engine": engine,
+        "tel": tel,
+        "jpeg": bytes(buf),
+        "ckpt_v2": ckpt_v2,
+        "step_v2": base_step + 100,
+    }
+    telemetry.disable()
+
+
+def _boot(stack, **overrides):
+    from sat_tpu.serve.server import CaptionServer
+
+    config = stack["config"].replace(**overrides)
+    return CaptionServer(config, stack["engine"], port=0).start()
+
+
+def _post(port, data, headers=None, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/caption",
+        data=data,
+        method="POST",
+        headers={"Content-Type": "image/jpeg", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return r.status, r.read()
+
+
+def _captions(payload):
+    return [c["caption"] for c in payload["captions"]]
+
+
+def test_default_tenant_parity_bitwise(stack):
+    """The acceptance pin: a multi-tenant server answers the default
+    tenant (bare requests AND explicit X-Tenant) with byte-identical
+    captions to a no-``--tenants`` server, with zero new compiles."""
+    jpeg, tel = stack["jpeg"], stack["tel"]
+    server = _boot(stack)  # tenants=""
+    try:
+        assert not server.tenants.multi
+        status, payload, _h = _post(server.port, jpeg)
+        assert status == 200
+        assert "tenant" not in payload  # single-tenant schema unchanged
+        baseline = _captions(payload)
+        _s, stats_raw = _get(server.port, "/stats")
+        assert "tenants" not in json.loads(stats_raw)
+    finally:
+        server.shutdown()
+
+    compiles0 = tel.counters().get("jax/compiles", 0)
+    server = _boot(stack, tenants="alpha:4,beta:1")
+    try:
+        assert server.tenants.multi
+        status, payload, _h = _post(server.port, jpeg)  # bare request
+        assert status == 200 and payload["tenant"] == "alpha"
+        assert _captions(payload) == baseline
+        status, payload, _h = _post(
+            server.port, jpeg, headers={"X-Tenant": "beta"}
+        )
+        assert status == 200 and payload["tenant"] == "beta"
+        assert _captions(payload) == baseline  # same params either lane
+    finally:
+        server.shutdown()
+    assert tel.counters().get("jax/compiles", 0) == compiles0
+
+
+def test_tenant_quota_shed_contract(stack):
+    """Over-quota requests shed 429 with X-Shed-Scope: tenant and a
+    never-0s Retry-After from THAT bucket's refill; the unlimited
+    tenant is untouched and the shed shows up in the per-tenant
+    counters."""
+    jpeg, tel = stack["jpeg"], stack["tel"]
+    shed0 = tel.counters().get("serve/tenant_capped_shed", 0)
+    server = _boot(stack, tenants="free:4,capped:1:0.2:2")
+    try:
+        outcomes = [
+            _post(server.port, jpeg, headers={"X-Tenant": "capped"})
+            for _ in range(4)
+        ]
+        sheds = [(s, p, h) for s, p, h in outcomes if s == 429]
+        assert len(sheds) >= 1  # burst 2, refill 0.2/s: the tail sheds
+        assert all(s in (200, 429) for s, _p, _h in outcomes)
+        for _s, payload, headers in sheds:
+            assert payload["shed_scope"] == "tenant"
+            assert payload["retry_after_ms"] >= 1
+            assert "capped" in payload["error"]
+            assert headers["X-Shed-Scope"] == "tenant"
+            assert int(headers["Retry-After"]) >= 1
+        status, payload, _h = _post(
+            server.port, jpeg, headers={"X-Tenant": "free"}
+        )
+        assert status == 200 and payload["tenant"] == "free"
+        counters = tel.counters()
+        assert counters.get("serve/tenant_capped_shed", 0) - shed0 >= 1
+        assert counters.get("serve/tenant_capped_429", 0) >= 1
+    finally:
+        server.shutdown()
+
+
+def test_unknown_tenant_rides_default_and_counts(stack):
+    jpeg, tel = stack["jpeg"], stack["tel"]
+    unknown0 = tel.counters().get("serve/tenant_unknown", 0)
+    server = _boot(stack, tenants="main:2,side:1")
+    try:
+        status, payload, _h = _post(
+            server.port, jpeg, headers={"X-Tenant": "nosuch"}
+        )
+        assert status == 200 and payload["tenant"] == "main"
+        assert tel.counters().get("serve/tenant_unknown", 0) == unknown0 + 1
+    finally:
+        server.shutdown()
+
+
+def test_resident_model_shares_warmed_executables(stack):
+    """N=2 resident param sets: the second model serves through the
+    SAME warmed AOT executables (params are runtime operands) — zero
+    new compiles — and X-Model / the tenant's default model both pin
+    it."""
+    jpeg, tel = stack["jpeg"], stack["tel"]
+    registry = os.path.join(
+        os.path.dirname(stack["config"].save_dir), "registry.json"
+    )
+    with open(registry, "w") as f:
+        json.dump(
+            {
+                "default": "anchor",
+                "models": {"v2": stack["ckpt_v2"]},
+                "tenants": [
+                    {"name": "anchor", "weight": 2.0},
+                    {"name": "pinned", "weight": 1.0, "model": "v2"},
+                ],
+            },
+            f,
+        )
+    server = _boot(stack, tenants=registry)
+    try:
+        assert stack["engine"].resident_aliases == ("v2",)
+        assert stack["engine"].resident_step("v2") == stack["step_v2"]
+        compiles0 = tel.counters().get("jax/compiles", 0)
+
+        status, incumbent, _h = _post(server.port, jpeg)
+        assert status == 200 and incumbent["slot"] == "incumbent"
+
+        # the tenant's default model routes without any header
+        status, payload, _h = _post(
+            server.port, jpeg, headers={"X-Tenant": "pinned"}
+        )
+        assert status == 200
+        assert payload["slot"] == "v2" and payload["model"] == "v2"
+        assert payload["model_step"] == stack["step_v2"]
+
+        # an explicit X-Model overrides for any tenant
+        status, payload2, _h = _post(
+            server.port, jpeg, headers={"X-Model": "v2"}
+        )
+        assert status == 200 and payload2["slot"] == "v2"
+        assert _captions(payload2) == _captions(payload)
+
+        status, payload, _h = _post(
+            server.port, jpeg, headers={"X-Model": "ghost"}
+        )
+        assert status == 400 and payload["models"] == ["v2"]
+
+        assert tel.counters().get("jax/compiles", 0) == compiles0
+    finally:
+        server.shutdown()
+
+
+def test_stats_metrics_healthz_tenant_blocks(stack):
+    jpeg = stack["jpeg"]
+    server = _boot(stack, tenants="alpha:4,beta:1:5:5")
+    try:
+        _post(server.port, jpeg, headers={"X-Tenant": "beta"})
+        _s, raw = _get(server.port, "/stats")
+        stats = json.loads(raw)
+        block = stats["tenants"]
+        assert sorted(block) == ["alpha", "beta"]
+        assert block["beta"]["requests"] >= 1
+        assert block["beta"]["weight"] == 1.0
+        assert block["beta"]["tokens"] is not None
+        assert block["alpha"]["queue_depth"] == 0
+        assert "latency_ms" in block["beta"]
+        _s, metrics = _get(server.port, "/metrics")
+        assert b"serve/tenant_beta_requests" in metrics
+        _s, health = _get(server.port, "/healthz")
+        assert json.loads(health)["tenants"] == ["alpha", "beta"]
+    finally:
+        server.shutdown()
