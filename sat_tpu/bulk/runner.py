@@ -40,6 +40,7 @@ import numpy as np
 
 from .. import telemetry
 from ..config import Config
+from ..telemetry.metering import measured_busy_ms
 from ..resilience.faultinject import FaultPlan
 from ..resilience.preempt import GracefulShutdown
 from ..resilience.quarantine import (
@@ -339,6 +340,15 @@ def run_bulk(config: Config, model_file: Optional[str] = None) -> int:
             "bulk/steady_compiles",
             tel.counters().get("jax/compiles", 0) - engine.compiles_at_ready,
         )
+        # unit cost for capacity planning: measured device-busy ms
+        # (encode + decode spans) over images finished this run — the
+        # same busy-span definition the serve-side metering reconciles
+        # its per-request attribution against
+        if decoded_this_run > 0:
+            tel.gauge(
+                "bulk/device_ms_per_image",
+                round(measured_busy_ms(tel) / decoded_this_run, 3),
+            )
 
     _progress_gauges()
     interrupted = False
@@ -406,10 +416,14 @@ def run_bulk(config: Config, model_file: Optional[str] = None) -> int:
         return 0
     elapsed = time.perf_counter() - t0
     rate = decoded_this_run / elapsed if elapsed > 0 else 0.0
+    unit_ms = (
+        measured_busy_ms(tel) / decoded_this_run if decoded_this_run else 0.0
+    )
     _log(
         f"bulk: complete — {images_done}/{total} images in "
         f"{len(shards)} shards ({decoded_this_run} decoded this run, "
-        f"{rate:.1f} captions/s, {quarantine.total} quarantined)"
+        f"{rate:.1f} captions/s, {unit_ms:.1f} device-ms/image, "
+        f"{quarantine.total} quarantined)"
     )
     if bb is not None:
         bb.event("bulk_complete", images=images_done, quarantined=quarantine.total)

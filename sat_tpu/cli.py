@@ -241,6 +241,22 @@ def build_config(argv: Optional[List[str]] = None):
              "single-tenant)",
     )
     p.add_argument(
+        "--serve_metering", choices=("on", "off"), default=None,
+        help="serve phase: per-request cost attribution + per-tenant "
+             "metering ledger + online capacity model (telemetry/"
+             "metering.py, telemetry/capacity.py; docs/OBSERVABILITY.md "
+             "'Cost attribution'). Only active when telemetry is on; "
+             "default Config.serve_metering=True",
+    )
+    p.add_argument(
+        "--slo_capacity_headroom_pct", type=float, default=None,
+        metavar="PCT",
+        help="serve phase: capacity_headroom SLO objective — alert when "
+             "the capacity model's headroom gauge falls below PCT "
+             "(gauge_floor kind; 0 disables; default "
+             "Config.slo_capacity_headroom_pct=0)",
+    )
+    p.add_argument(
         "--encoder_quant", choices=("off", "bf16", "int8"), default=None,
         help="serve phase: post-training quantization of the frozen CNN "
              "encoder at param load, before AOT warmup (docs/SERVING.md "
@@ -408,6 +424,12 @@ def build_config(argv: Optional[List[str]] = None):
         ))
     if args.tenants is not None:
         config = config.replace(tenants=args.tenants)
+    if args.serve_metering is not None:
+        config = config.replace(serve_metering=args.serve_metering == "on")
+    if args.slo_capacity_headroom_pct is not None:
+        config = config.replace(
+            slo_capacity_headroom_pct=args.slo_capacity_headroom_pct
+        )
     if args.encoder_quant is not None:
         config = config.replace(encoder_quant=args.encoder_quant)
     if args.model_reload is not None:

@@ -172,6 +172,11 @@ class Config:
     slo_error_ratio: float = 0.0       # serve: 5xx / all requests
     slo_captions_per_s: float = 0.0    # train: step rate x batch_size floor
     slo_ckpt_age_s: float = 0.0        # train: newest-checkpoint age ceiling
+    # serve: minimum capacity headroom % (telemetry/capacity.py) — burns
+    # when the online capacity model's headroom gauge falls below this
+    # floor, paging on approach to the replica's effective-captions/s
+    # ceiling instead of after latency melts
+    slo_capacity_headroom_pct: float = 0.0
     # ---- fleet plane + black box (telemetry/fleet.py, blackbox.py; ----
     # ---- docs/OBSERVABILITY.md "Fleet & Postmortem") ----
     # cross-host aggregation at the log boundary: per-process
@@ -247,6 +252,14 @@ class Config:
     # burn lanes, and optional per-tenant resident models.  "" = the
     # single-tenant plane (bit-identical to pre-tenant serving).
     tenants: str = ""
+    # per-request cost attribution + tenant metering + the online
+    # capacity model (telemetry/metering.py, telemetry/capacity.py):
+    # attributes encode/decode device time, slot occupancy and host
+    # phases per request, rolls them up per tenant into metering.jsonl /
+    # /stats / /metrics, and publishes capacity headroom gauges.  Only
+    # active when telemetry is on (all attribution rides telemetry-gated
+    # already-synced boundaries); off skips ledger and gauges entirely.
+    serve_metering: bool = True
 
     # ---- model lifecycle (sat_tpu/lifecycle; docs/SERVING.md) ----
     # zero-downtime model refresh: a reloader thread polls the lineage

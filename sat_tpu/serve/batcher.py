@@ -41,6 +41,7 @@ import numpy as np
 
 from .. import telemetry
 from ..resilience import faultinject
+from ..telemetry.metering import RequestCost
 from .engine import BucketOverflow
 from .scheduler import DeficitRoundRobin
 
@@ -108,6 +109,11 @@ class Request:
     # attribution runs from here to harvest)
     t_admit_ns: Optional[int] = None
     trace: Optional[Any] = None
+    # per-request device-cost accumulator (telemetry/metering.py):
+    # created at submit when telemetry is on; attribution sites charge
+    # it on already-synced boundaries and the server's terminal funnel
+    # folds it into the tenant ledger.  None with telemetry off.
+    cost: Optional[RequestCost] = None
 
     def mark(self, phase: str, t0_ns: int, dur_ns: int) -> None:
         if self.trace is not None:
@@ -192,6 +198,7 @@ class _BatcherBase:
             trace=trace,
             slot=slot,
             tenant=tenant,
+            cost=RequestCost() if self._tel.enabled else None,
         )
         try:
             self._q.put_nowait(req)
@@ -218,6 +225,11 @@ class _BatcherBase:
     def tenant_depths(self) -> Dict[str, int]:
         """Per-tenant queued depth (the /stats tenants block)."""
         return self._q.depths()
+
+    def tenant_admitted(self) -> Dict[str, int]:
+        """Cumulative per-tenant scheduler admissions (the /stats
+        tenants block's reconciliation count against the cost ledger)."""
+        return self._q.admitted()
 
     @property
     def draining(self) -> bool:
@@ -413,7 +425,9 @@ class MicroBatcher(_BatcherBase):
     def _dispatch(self, live: List[Request], slot: str = "incumbent"):
         t0 = time.perf_counter_ns()
         batch, bucket = self.engine.pad_batch([r.image for r in live])
-        out = self.engine.dispatch(batch, slot=slot)
+        out = self.engine.dispatch(
+            batch, slot=slot, costs=[r.cost for r in live]
+        )
         t1 = time.perf_counter_ns()
         self._tel.record("serve/dispatch", t0, t1 - t0)
         self._tel.count("serve/batches")
@@ -422,6 +436,9 @@ class MicroBatcher(_BatcherBase):
         for r in live:
             r.bucket = bucket
             r.mark("dispatch", t0, t1 - t0)
+            # batch-mode occupancy runs dispatch→drain: the window this
+            # request's bucket row held device-resident beam state
+            r.t_admit_ns = t1
         return out
 
     def _finish(self, entry) -> None:
@@ -450,6 +467,19 @@ class MicroBatcher(_BatcherBase):
             # the aggregate span keeps its pre-split meaning (drain+detok)
             # so /stats latency percentiles stay comparable across runs
             self._tel.record("serve/detok", t0, t2 - t0)
+            if self._tel.enabled:
+                # decode attribution (telemetry/metering.py): the drained
+                # window is the batch's decode device time — each live
+                # request is charged an equal share, and the window span
+                # doubles as the measured-busy feed for the accounting
+                # identity (BUSY_SPANS)
+                self._tel.record("serve/decode_window", t0, t1 - t0)
+                share = (t1 - t0) // len(live)
+                for r in live:
+                    if r.cost is not None:
+                        r.cost.add_decode(share)
+                        if r.t_admit_ns is not None:
+                            r.cost.set_occupancy(t1 - r.t_admit_ns)
             for r in live:
                 r.mark("drain", t0, t1 - t0)
                 r.mark("detok", t1, t2 - t1)
@@ -739,12 +769,23 @@ class ContinuousBatcher(_BatcherBase):
             if pool.occupancy() == 0:
                 continue
             self._plan.maybe_slow_canary(pool.param_slot)
+            live = pool.inflight_payloads() if self._tel.enabled else None
             t0 = time.perf_counter_ns()
             done_dev, steps_dev = pool.multi_step(k)
             done = np.asarray(done_dev)  # sync-ok: step boundary — the continuous loop's one bounded sync
             steps_run = int(np.asarray(steps_dev))  # sync-ok: same dispatch as the done drain above
             t1 = time.perf_counter_ns()
             self._tel.record("serve/step", t0, t1 - t0)
+            if live:
+                # decode attribution (telemetry/metering.py): every live
+                # slot riding this fused window is charged an equal share
+                # — the marginal cost of keeping its slot hot for these
+                # steps_run steps, weighted by pool fill per dispatch
+                share = (t1 - t0) // len(live)
+                for r in live:
+                    cost = getattr(r, "cost", None)
+                    if cost is not None:
+                        cost.add_decode(share)
             # the chosen-K lane as its own named span: in Perfetto the
             # serve/dispatch_k* tracks show dispatch amortization live
             self._tel.record(f"serve/dispatch_k{k}", t0, t1 - t0)
@@ -795,6 +836,12 @@ class ContinuousBatcher(_BatcherBase):
             r.mark("drain", t0, t1 - t0)
             if r.t_admit_ns is not None:
                 r.mark("decode", r.t_admit_ns, t1 - r.t_admit_ns)
+                if r.cost is not None:
+                    # occupancy: seeded → harvested, the HBM-seconds this
+                    # request's slot (KV pages, beam state) was held
+                    r.cost.set_occupancy(t1 - r.t_admit_ns)
+            if r.cost is not None:
+                r.cost.decode_steps += int(steps[i])
             # raw per-request loop-iteration count (not ns): short
             # captions SHOW their early retirement here
             self._tel.record("serve/decode_steps", 0, int(steps[i]))

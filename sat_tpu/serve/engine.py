@@ -405,13 +405,17 @@ class ServeEngine:
         Raises ValueError on undecodable bytes (frontend maps to 400)."""
         return self.loader.load_bytes(data)
 
-    def dispatch(self, images: np.ndarray, slot: str = "incumbent"):
+    def dispatch(self, images: np.ndarray, slot: str = "incumbent", costs=None):
         """Async: padded batch [bucket,S,S,3] → BeamResult of device
         arrays.  Calls the AOT executables directly, so the only work on
         this thread is argument transfer — the device runs ahead while the
         host returns to batching (the ``device_prefetch`` overlap).
         ``slot`` selects which param tree the warmed executables run
-        against (incumbent or the staged canary candidate)."""
+        against (incumbent or the staged canary candidate).  ``costs``
+        (optional) is the live requests' ``RequestCost`` accumulators —
+        each is charged an equal share of the measured encode window
+        (telemetry/metering.py; only meaningful with telemetry on, since
+        the window is only measured inside the tel-gated block)."""
         import jax
 
         variables = self.slot_variables(slot)
@@ -425,12 +429,16 @@ class ServeEngine:
             # the beam dispatch — the device queue keeps its ordering and
             # the beam dispatch happens immediately after either way
             jax.block_until_ready(contexts)  # sync-ok: opt-in telemetry encode timing, gated on tel.enabled
-            self._tel.record("serve/encode", t0, time.perf_counter_ns() - t0)
-            self._tel.record(
-                f"serve/encode_lane{images.shape[0]}",
-                t0,
-                time.perf_counter_ns() - t0,
-            )
+            dur = time.perf_counter_ns() - t0
+            self._tel.record("serve/encode", t0, dur)
+            self._tel.record(f"serve/encode_lane{images.shape[0]}", t0, dur)
+            if costs:
+                share = dur // len(costs)
+                for cost in costs:
+                    if cost is not None:
+                        cost.add_encode(share)
+                self._tel.count("serve/encode_images", len(costs))
+                self._tel.count("serve/encode_lane_slots", images.shape[0])
         return beam_exec(decoder_params, contexts)
 
     def drain_output(self, out, n: int) -> Tuple[np.ndarray, ...]:

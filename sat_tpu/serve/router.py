@@ -188,6 +188,31 @@ def merge_fleet(
     }
 
 
+def fleet_tenants_cost(
+    replicas: Dict[str, Dict[str, Any]]
+) -> Dict[str, Dict[str, float]]:
+    """Fan the per-replica ``tenants_cost`` blocks (cumulative metering
+    snapshots polled off each replica's /stats) into one fleet-wide
+    per-tenant view: every numeric field sums across replicas, because
+    each replica's snapshot is cumulative for *its* share of the
+    tenant's traffic.  Pure dict arithmetic — stays jax-free."""
+    fleet: Dict[str, Dict[str, float]] = {}
+    for snap in replicas.values():
+        block = snap.get("tenants_cost")
+        if not isinstance(block, dict):
+            continue
+        for tenant, row in block.items():
+            if not isinstance(row, dict):
+                continue
+            agg = fleet.setdefault(str(tenant), {})
+            for key, value in row.items():
+                if isinstance(value, (int, float)) and not isinstance(
+                    value, bool
+                ):
+                    agg[key] = round(agg.get(key, 0) + value, 3)
+    return fleet
+
+
 def _percentiles_ms(tel, name: str) -> Optional[Dict[str, Any]]:
     """p50/p95/p99 (ms) of a router span; host telemetry ring only."""
     data = np.asarray(tel.durations_ns(name), np.float64)  # sync-ok: host telemetry ring
@@ -500,6 +525,12 @@ class Router:
             snap["slot_busy"] = pool.get("busy")
         if "compiles_since_ready" in stats:
             snap["compiles_since_ready"] = stats["compiles_since_ready"]
+        cost = stats.get("tenants_cost")
+        if isinstance(cost, dict):
+            snap["tenants_cost"] = cost
+        cap = stats.get("capacity")
+        if isinstance(cap, dict) and "headroom_pct" in cap:
+            snap["capacity_headroom_pct"] = cap["headroom_pct"]
 
     def _advance_drains(self) -> None:
         """Drain progression: a locally spawned replica is drained when
@@ -1072,6 +1103,11 @@ class Router:
             },
             "drain_log": drain_log,
             **({"tenants": tenants_block} if tenants_block else {}),
+            **(
+                {"tenants_cost": fleet_cost}
+                if (fleet_cost := fleet_tenants_cost(view["replicas"]))
+                else {}
+            ),
         }
 
     def metrics_text(self) -> str:
@@ -1082,6 +1118,19 @@ class Router:
         self._tel.gauge(
             "route/straggler", 1 if view["straggler"].get("verdict") else 0
         )
+        # fleet-wide per-tenant cost + the tightest replica headroom ride
+        # the router scrape so one dashboard covers the whole fleet
+        for tenant, row in fleet_tenants_cost(view["replicas"]).items():
+            self._tel.gauge(
+                f"route/tenant_{tenant}_device_ms", row.get("device_ms", 0.0)
+            )
+        headrooms = [
+            snap["capacity_headroom_pct"]
+            for snap in view["replicas"].values()
+            if isinstance(snap.get("capacity_headroom_pct"), (int, float))
+        ]
+        if headrooms:
+            self._tel.gauge("route/fleet_headroom_pct", min(headrooms))
         return promtext.render(self._tel)
 
     # -- lifecycle ---------------------------------------------------------

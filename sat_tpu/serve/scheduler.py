@@ -81,6 +81,10 @@ class DeficitRoundRobin:
         # currently spending its deficit
         self._rotation: Deque[str] = deque()
         self._size = 0
+        # cumulative pops per tenant: each pop is one granted admission
+        # (a decode seat), so this is the scheduler-side input to the
+        # per-tenant metering rollup
+        self._admitted: Dict[str, int] = {}
         self._cond = threading.Condition()
 
     def weight(self, tenant: str) -> float:
@@ -120,6 +124,7 @@ class DeficitRoundRobin:
                 item = q.popleft()
                 self._size -= 1
                 self._deficit[tenant] -= 1.0
+                self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
                 if not q:
                     # leaving the rotation resets the deficit: no
                     # banking across idle periods
@@ -155,6 +160,13 @@ class DeficitRoundRobin:
         """Per-tenant queued depth (the /stats + heartbeat feed)."""
         with self._cond:
             return {t: len(q) for t, q in self._queues.items() if q}
+
+    def admitted(self) -> Dict[str, int]:
+        """Cumulative per-tenant admissions (pops) since boot — the
+        scheduler's contribution to the tenants-cost rollup: queue-side
+        counts to reconcile against the ledger's completed counts."""
+        with self._cond:
+            return dict(self._admitted)
 
     def drain_all(self) -> List:
         """Pop everything in deficit order (shutdown paths)."""

@@ -62,9 +62,12 @@ from ..lifecycle import LifecycleController
 from ..lifecycle import canary as canary_mod
 from ..resilience.preempt import GracefulShutdown
 from ..telemetry import promtext, tracectx
+from ..telemetry.capacity import CapacityModel, EncodeCacheSketch
 from ..telemetry.heartbeat import Heartbeat
+from ..telemetry.metering import MeteringLedger
 from ..telemetry.profwin import ProfileLatch
 from ..telemetry.slo import SLOEngine, objectives_from_config
+from ..utils.summary import crc32c
 from .batcher import ContinuousBatcher, MicroBatcher, Rejected
 from .engine import ServeEngine, load_serving_state
 from .slot_pool import PagedSlotPool
@@ -79,6 +82,24 @@ _LATENCY_SPANS = (
     "serve/detok_queue",
     "serve/detok",
 )
+
+# /metrics histogram families (telemetry/promtext.py): true cumulative
+# _bucket/_sum/_count exposition alongside the percentile gauges, so
+# Prometheus picks its own quantiles server-side.  Latency bounds in
+# seconds (the Prometheus convention); steps-per-dispatch raw counts
+# matching the fused-decode K ladder.
+_HISTOGRAMS: Dict[str, promtext.HistogramSpec] = {
+    "sat_request_latency_seconds": (
+        "serve/request",
+        (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+        1e-9,
+    ),
+    "sat_steps_per_dispatch": (
+        "serve/steps_per_dispatch",
+        (1.0, 2.0, 4.0, 8.0, 16.0, 32.0),
+        1.0,
+    ),
+}
 
 
 def _percentiles_ms(tel, name: str) -> Optional[Dict[str, Any]]:
@@ -332,6 +353,38 @@ class CaptionServer:
             path=os.path.join(tdir, "access.jsonl"), cap_bytes=cap_bytes
         )
         self.profiles = ProfileLatch(tdir)
+        # cost attribution + capacity plane (telemetry/metering.py,
+        # telemetry/capacity.py): the per-tenant ledger, the would-be
+        # encode-cache probe, and the headroom model — all host-side
+        # arithmetic on already-synced boundaries, only constructed when
+        # telemetry is live (attribution rides telemetry-gated windows)
+        self.metering: Optional[MeteringLedger] = None
+        self.capacity: Optional[CapacityModel] = None
+        self._cache_sketch: Optional[EncodeCacheSketch] = None
+        if config.serve_metering and self._tel.enabled:
+            self.metering = MeteringLedger(
+                path=os.path.join(tdir, "metering.jsonl"),
+                cap_bytes=cap_bytes,
+                tel=self._tel,
+            )
+            self._cache_sketch = EncodeCacheSketch()
+            self.capacity = CapacityModel(
+                self._tel,
+                self.metering,
+                # capacity denominator: decode seats — pool slots in
+                # continuous mode, the largest warmed bucket in batch
+                # (engine doubles/stubs without buckets fall back to the
+                # configured batch ceiling)
+                slots=(
+                    self.pool.slots
+                    if self.pool is not None
+                    else max(
+                        getattr(engine, "buckets", None)
+                        or (config.serve_max_batch,)
+                    )
+                ),
+                sketch=self._cache_sketch,
+            )
         self.slo = SLOEngine(
             self._tel,
             objectives_from_config(
@@ -402,10 +455,12 @@ class CaptionServer:
         bucket: Optional[int] = None,
         slot: str = canary_mod.INCUMBENT,
         tenant: Optional[str] = None,
+        cost=None,
     ) -> Tuple[int, Dict[str, Any]]:
         """Every terminal /caption reply funnels through here: the access
-        log gets its record, the SLO error-ratio counters tick, and the
-        payload learns its request id."""
+        log gets its record, the SLO error-ratio counters tick, the
+        request's attributed cost is charged to its tenant's meter, and
+        the payload learns its request id."""
         total_ns = time.perf_counter_ns() - trace.t_start_ns
         with self._in_flight_lock:
             self._in_flight = max(0, self._in_flight - 1)
@@ -438,12 +493,28 @@ class CaptionServer:
             self._tel.record(
                 "serve/canary_request", trace.t_start_ns, total_ns
             )
+        meter_tenant = tenant if tenant is not None else "default"
+        if self.metering is not None:
+            # queue/detok host phases lift straight off the trace — no
+            # new timing; device phases arrive attributed on ``cost``
+            phases = trace.phases
+            self.metering.charge(
+                meter_tenant,
+                cost=cost,
+                queue_ms=phases.get("queue_wait", (0, 0))[1] / 1e6,
+                detok_ms=phases.get("detok", (0, 0))[1] / 1e6,
+                error=status >= 500,
+            )
+        if self.capacity is not None:
+            self.capacity.maybe_update()  # rate-limited; off-interval = one clock read
         self.tracer.finish(
             trace,
             status,
             total_ns,
             bucket=bucket,
             error=payload.get("error"),
+            tenant=tenant,
+            cost=cost,
         )
         payload["request_id"] = trace.trace_id
         return status, payload
@@ -514,6 +585,12 @@ class CaptionServer:
                 },
                 tenant=tname,
             )
+        if self._cache_sketch is not None:
+            # would-be encode-cache probe (telemetry/capacity.py): hash
+            # the raw POST bytes (no pixels retained) and ask whether a
+            # bounded cache would have hit — the live Zipf evidence for
+            # the encode-cache split (ROADMAP item 2)
+            self._cache_sketch.observe(crc32c(body))
         if deadline_ms is None or deadline_ms == "":
             budget_ms = self.config.serve_deadline_ms
         else:
@@ -574,9 +651,12 @@ class CaptionServer:
         )
         if not req.done.wait(timeout=wait_s):
             self._tel.count("serve/timeouts")
+            # the request may still be riding decode windows; charge
+            # whatever device time it accrued so far — abandoned work is
+            # still the tenant's cost
             return self._finish_request(
                 trace, 504, {"error": "request timed out in service"},
-                slot=slot, tenant=tname,
+                slot=slot, tenant=tname, cost=req.cost,
             )
         if req.error is not None:
             payload = {"error": req.error[1]}
@@ -585,7 +665,7 @@ class CaptionServer:
                 payload["shed_scope"] = "global"
             return self._finish_request(
                 trace, req.error[0], payload, bucket=req.bucket, slot=slot,
-                tenant=tname,
+                tenant=tname, cost=req.cost,
             )
         self._tel.record(
             "serve/request", t_req0, time.perf_counter_ns() - t_req0
@@ -619,7 +699,8 @@ class CaptionServer:
             except (KeyError, IndexError, TypeError):
                 pass
         return self._finish_request(
-            trace, 200, payload, bucket=req.bucket, slot=slot, tenant=tname
+            trace, 200, payload, bucket=req.bucket, slot=slot, tenant=tname,
+            cost=req.cost,
         )
 
     def _retry_hint_ms(self) -> int:
@@ -819,6 +900,18 @@ class CaptionServer:
             }
         if self.tenants.multi:
             out["tenants"] = self._tenant_block(counters)
+        if self.metering is not None:
+            # per-tenant attributed cost (telemetry/metering.py) — the
+            # router fans this block in for the fleet-wide view; present
+            # with one "default" row on single-tenant servers too
+            out["tenants_cost"] = self.metering.snapshot()
+        if self.capacity is not None:
+            self.capacity.maybe_update()
+            out["capacity"] = {
+                name.split("/", 1)[1]: value
+                for name, value in self._tel.gauges().items()
+                if name.startswith("capacity/")
+            }
         return out
 
     def _tenant_block(self, counters: Dict[str, int]) -> Dict[str, Any]:
@@ -827,10 +920,12 @@ class CaptionServer:
         and latency percentiles.  Refreshes the serve/tenant_* gauges so
         the heartbeat serve block and /metrics carry the same numbers."""
         depths = self.batcher.tenant_depths()
+        admitted = self.batcher.tenant_admitted()
         block: Dict[str, Any] = {}
         for name, shape in self.tenants.describe().items():
             entry = dict(shape)
             entry["queue_depth"] = depths.get(name, 0)
+            entry["admitted"] = admitted.get(name, 0)
             tokens = self.tenants.tokens(name)
             if tokens is not None and tokens != float("inf"):  # sync-ok: host sentinel
                 entry["tokens"] = round(tokens, 2)
@@ -896,8 +991,13 @@ class CaptionServer:
             # time (the tenant dimension rides the metric name, so
             # promtext exports them with no label machinery)
             self._tenant_block(self._tel.counters())
+        if self.capacity is not None:
+            # scrape-time refresh of the capacity/* gauges (headroom,
+            # ceiling, lane fill, would-hit ratio) — rate-limited, so an
+            # aggressive scraper costs one clock read per scrape
+            self.capacity.maybe_update()
         extra = self.heartbeat.payload() if self.heartbeat else None
-        return promtext.render(self._tel, extra=extra)
+        return promtext.render(self._tel, extra=extra, histograms=_HISTOGRAMS)
 
     def start_profile(self, duration_ms=None) -> Tuple[bool, str]:
         """Begin a bounded live profiler capture (``POST /profile``);
@@ -998,6 +1098,11 @@ class CaptionServer:
         self._httpd = None
         self.slo.stop()
         self.profiles.stop_now()
+        if self.metering is not None:
+            # final cumulative ledger rows — the shutdown snapshot a
+            # billing job replays (torn tails before this lose only
+            # recency, never correctness)
+            self.metering.maybe_flush(force=True)
         self.export_trace()  # no-op unless --trace_export is set
         if self.heartbeat is not None:
             self.heartbeat.stop()

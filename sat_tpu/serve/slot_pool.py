@@ -87,8 +87,10 @@ class PagedSlotPool:
         self.eos_id = engine.eos_id
         self._tel = tel if tel is not None else engine._tel
         # host bookkeeping: free-slot set + slot -> opaque payload binding
-        # (the batcher binds its Request objects; the pool never looks
-        # inside them)
+        # (the batcher binds its Request objects; the pool treats them as
+        # opaque except for one duck-typed hook — a payload carrying a
+        # ``cost`` attribute gets its encode-lane share attributed, see
+        # telemetry/metering.py; bulk's int payloads simply skip it)
         self._free = set(range(self.slots))
         self._payload = {}
         self._mask = np.zeros((self.slots,), np.bool_)
@@ -281,6 +283,7 @@ class PagedSlotPool:
             )
             slot_src = np.zeros((self.slots,), np.int32)
             admit_mask = np.zeros((self.slots,), np.bool_)
+            chunk_payloads = []
             for j in range(chunk):
                 image, payload = items[admitted]
                 admitted += 1
@@ -291,6 +294,7 @@ class PagedSlotPool:
                 self._free.discard(s)
                 self._payload[s] = payload
                 self._mask[s] = True
+                chunk_payloads.append(payload)
             t0 = time.perf_counter_ns()
             contexts = self._enc_execs[lane](
                 self.engine.slot_variables(self.param_slot),
@@ -305,6 +309,17 @@ class PagedSlotPool:
                 dur = time.perf_counter_ns() - t0
                 self._tel.record("serve/encode", t0, dur)
                 self._tel.record(f"serve/encode_lane{lane}", t0, dur)
+                # cost attribution (telemetry/metering.py): each request
+                # in the chunk is charged an equal share of this lane's
+                # measured window; padded lane slots bill nobody but feed
+                # the encode-lane-fill capacity gauge
+                share = dur // chunk
+                for payload in chunk_payloads:
+                    cost = getattr(payload, "cost", None)
+                    if cost is not None:
+                        cost.add_encode(share)
+                self._tel.count("serve/encode_images", chunk)
+                self._tel.count("serve/encode_lane_slots", lane)
             self._carry = self._seed_execs[lane](
                 self.engine.slot_decoder_params(self.param_slot),
                 self._carry,

@@ -341,6 +341,17 @@ class TenantRegistry:
         bucket = self._buckets.get(name)
         return None if bucket is None else bucket.tokens()
 
+    def use_clock(self, clock) -> None:
+        """Rebind every bucket's refill clock (test hook).  Registries
+        built inside a booted server own their buckets, so timing tests
+        freeze refill *after* boot by swapping in an injectable clock —
+        each bucket re-anchors its last-refill time on the new clock so
+        no retroactive refill is credited at the swap."""
+        for bucket in self._buckets.values():
+            with bucket._lock:
+                bucket._clock = clock
+                bucket._t_last = clock()
+
     # -- read side ---------------------------------------------------------
 
     def specs(self) -> List[TenantSpec]:

@@ -1,0 +1,177 @@
+"""Online capacity model + encode-cache opportunity probe.
+
+Built on the same feeds the metering ledger produces (occupancy-ms,
+request counts) plus the serve spans the batcher already records
+(steps/dispatch, encode-lane geometry), this module answers two
+forward-looking questions no raw counter does:
+
+* **How close to the ceiling is this replica?**  The pool's effective
+  captions/s ceiling is ``slots / mean_occupancy_s`` — how fast finished
+  requests vacate slots at the *current* traffic mix (caption lengths,
+  fused-window depths, encode-lane fill all priced in, because occupancy
+  is measured, not modeled).  Headroom is the unused fraction of slot
+  capacity; the SLO engine can burn on it (``capacity_headroom``
+  objective, a ``gauge_floor``), paging BEFORE latency melts instead of
+  after.
+
+* **Would an encode cache pay for itself?**  A crc32c-keyed sliding
+  sketch measures the *would-be* hit ratio of a bounded encode cache on
+  live traffic — the Zipf evidence ROADMAP item 2 needs before a line
+  of cache code is written.  Keys are post-image hashes; no pixels are
+  retained, so the probe is as cheap as a dict lookup and safe to leave
+  on.
+
+Everything here is host-side arithmetic over already-collected numbers:
+``maybe_update`` is rate-limited (once per ``interval_s``) and called
+from boundaries that already run per request or per scrape — zero
+device syncs, zero steady-state recompiles.
+
+Deliberately jax-free, like the rest of ``sat_tpu/telemetry``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, Optional
+
+
+class EncodeCacheSketch(object):
+    """Sliding-window membership sketch over request image keys.
+
+    ``observe(key)`` reports whether the key was seen within the last
+    ``window`` observations — exactly the hit a ``window``-entry LRU-ish
+    encode cache would have scored.  O(1) per observation: a deque for
+    recency eviction plus a refcount dict for membership (the same key
+    may appear several times inside one window)."""
+
+    def __init__(self, window: int = 4096) -> None:
+        self._window = max(int(window), 1)
+        self._ring: collections.deque = collections.deque()
+        self._counts: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self.lookups = 0
+        self.hits = 0
+
+    def observe(self, key: int) -> bool:
+        """Record one request's image key; True when a cache of this
+        window size would have hit."""
+        with self._lock:
+            self.lookups += 1
+            hit = key in self._counts
+            if hit:
+                self.hits += 1
+            self._ring.append(key)
+            self._counts[key] = self._counts.get(key, 0) + 1
+            if len(self._ring) > self._window:
+                old = self._ring.popleft()
+                left = self._counts[old] - 1
+                if left:
+                    self._counts[old] = left
+                else:
+                    del self._counts[old]
+            return hit
+
+    def ratio(self) -> float:
+        with self._lock:
+            return self.hits / self.lookups if self.lookups else 0.0
+
+
+class CapacityModel(object):
+    """Windowed capacity gauges from ledger totals + span aggregates.
+
+    Keeps the previous cumulative snapshot and differences against it on
+    each (rate-limited) update, so every gauge reflects the LAST window
+    of real traffic, not the lifetime average — a replica that was busy
+    an hour ago but idle now shows full headroom."""
+
+    def __init__(
+        self,
+        tel,
+        ledger,
+        slots: int,
+        interval_s: float = 1.0,
+        sketch: Optional[EncodeCacheSketch] = None,
+        clock=time.monotonic,
+    ) -> None:
+        self._tel = tel
+        self._ledger = ledger
+        self._slots = max(int(slots), 1)
+        self._interval = float(interval_s)
+        self._sketch = sketch
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._t_last = clock()
+        # previous cumulative readings (requests, occupancy_ms,
+        # steps-per-dispatch count/total, lane images/slots)
+        self._prev = dict.fromkeys(
+            ("req", "occ_ms", "spd_n", "spd_tot", "lane_img", "lane_slot"),
+            0.0,
+        )
+        self._ceiling = 0.0  # last known, held across idle windows
+
+    def _cumulative(self) -> Dict[str, float]:
+        snap = self._ledger.snapshot() if self._ledger is not None else {}
+        req = sum(r["requests"] for r in snap.values())
+        occ = sum(r["occupancy_ms"] for r in snap.values())
+        agg = self._tel.aggregates()
+        spd = agg.get("serve/steps_per_dispatch", (0, 0, 0))
+        ctr = self._tel.counters()
+        return {
+            "req": float(req),
+            "occ_ms": float(occ),
+            # record() stores raw step counts in the duration slot, so
+            # total "ns" here is total steps and count is dispatches
+            "spd_n": float(spd[0]),
+            "spd_tot": float(spd[1]),
+            "lane_img": float(ctr.get("serve/encode_images", 0.0)),
+            "lane_slot": float(ctr.get("serve/encode_lane_slots", 0.0)),
+        }
+
+    def maybe_update(self, force: bool = False) -> None:
+        """Recompute and publish the capacity gauges, at most once per
+        ``interval_s`` (call freely from request funnels and scrape
+        paths; off-interval calls cost one clock read)."""
+        now = self._clock()
+        with self._lock:
+            window_s = now - self._t_last
+            if not force and window_s < self._interval:
+                return
+            self._t_last = now
+            cur = self._cumulative()
+            prev, self._prev = self._prev, cur
+        if window_s <= 0:
+            return
+        d_req = cur["req"] - prev["req"]
+        d_occ_s = (cur["occ_ms"] - prev["occ_ms"]) / 1e3
+        # Occupancy is credited at retire, so a window can momentarily
+        # absorb more occupancy-seconds than it spans; clamp to [0, 1].
+        busy = min(max(d_occ_s / (self._slots * window_s), 0.0), 1.0)
+        if d_req > 0 and d_occ_s > 0:
+            self._ceiling = self._slots * d_req / d_occ_s
+        tel = self._tel
+        tel.gauge("capacity/slot_busy_ratio", round(busy, 4))
+        tel.gauge("capacity/headroom_pct", round(100.0 * (1.0 - busy), 2))
+        tel.gauge("capacity/ceiling_captions_per_s", round(self._ceiling, 3))
+        tel.gauge(
+            "capacity/completed_per_s",
+            round(d_req / window_s, 3) if d_req > 0 else 0.0,
+        )
+        d_disp = cur["spd_n"] - prev["spd_n"]
+        if d_disp > 0:
+            tel.gauge(
+                "capacity/steps_per_dispatch",
+                round((cur["spd_tot"] - prev["spd_tot"]) / d_disp, 3),
+            )
+        d_slot = cur["lane_slot"] - prev["lane_slot"]
+        if d_slot > 0:
+            tel.gauge(
+                "capacity/encode_lane_fill",
+                round((cur["lane_img"] - prev["lane_img"]) / d_slot, 4),
+            )
+        if self._sketch is not None and self._sketch.lookups:
+            tel.gauge(
+                "capacity/encode_cache_would_hit_ratio",
+                round(self._sketch.ratio(), 4),
+            )

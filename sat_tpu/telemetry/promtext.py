@@ -16,6 +16,13 @@ the code counts them):
   summary pairs from :meth:`Telemetry.aggregates`, so Prometheus can
   rate() a phase's time share the standard way.
 
+Callers may additionally request true histogram families (cumulative
+``_bucket{le=…}`` / ``_sum`` / ``_count`` exposition) for chosen spans
+via ``render(histograms=…)`` — bucket counts come from the recorder's
+retained sample window (the same source as the /stats percentiles), so
+``histogram_quantile()`` works server-side without the service choosing
+quantiles for you.
+
 :class:`MetricsListener` is the training-side carrier: a stdlib
 threading HTTP server exposing ``GET /metrics`` (this format) and
 ``GET /healthz`` (the heartbeat JSON) read-only — the caption server
@@ -28,9 +35,17 @@ import json
 import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, List, Mapping, Optional
+from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
 
 CONTENT_TYPE = "text/plain; version=0.0.4"
+
+# A histogram request: span name to sample, upper bounds (in OUTPUT
+# units, ascending; +Inf is implicit), and the factor converting the
+# recorder's raw int64 slot values into output units (1e-9 for ns→s;
+# 1.0 for spans that store raw counts, e.g. steps-per-dispatch).
+HistogramSpec = Tuple[str, Sequence[float], float]
 
 _ESCAPES = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
 
@@ -47,12 +62,38 @@ def _fmt(value) -> str:
     return repr(value) if isinstance(value, float) else str(int(value))
 
 
-def render(tel, extra: Optional[Mapping[str, object]] = None) -> str:
+def _histogram_lines(
+    tel, family: str, spec: HistogramSpec, lines: List[str]
+) -> None:
+    """Append one cumulative-bucket histogram family computed from the
+    span's retained sample window.  Bucket counts are le-cumulative per
+    the exposition format; ``_sum``/``_count`` cover the same window so
+    ``histogram_quantile()`` is internally consistent."""
+    span, bounds, scale = spec
+    values = tel.durations_ns(span).astype(np.float64) * scale
+    lines.append(f"# HELP {family} sampled window of span {span}")
+    lines.append(f"# TYPE {family} histogram")
+    sorted_values = np.sort(values)
+    for le in bounds:
+        n = int(np.searchsorted(sorted_values, float(le), side="right"))  # sync-ok: host telemetry ring
+        lines.append(f'{family}_bucket{{le="{_fmt(float(le))}"}} {n}')  # sync-ok: host scalar
+    lines.append(f'{family}_bucket{{le="+Inf"}} {values.size}')
+    lines.append(f"{family}_sum {_fmt(round(float(values.sum()), 9))}")  # sync-ok: host telemetry ring
+    lines.append(f"{family}_count {values.size}")
+
+
+def render(
+    tel,
+    extra: Optional[Mapping[str, object]] = None,
+    histograms: Optional[Mapping[str, HistogramSpec]] = None,
+) -> str:
     """The exposition document for ``tel``'s current state.
 
     ``extra`` merges additional numeric scalars into the gauge family
     (non-numeric values are skipped, not errors — callers hand whole
-    heartbeat payloads over without filtering)."""
+    heartbeat payloads over without filtering).  ``histograms`` maps
+    family names to :data:`HistogramSpec` requests; each renders a true
+    cumulative-bucket histogram alongside the three standing families."""
     lines: List[str] = []
 
     counters = tel.counters()
@@ -91,6 +132,10 @@ def render(tel, extra: Optional[Mapping[str, object]] = None) -> str:
             f'sat_span_seconds_sum{{span="{label}"}} '
             f"{_fmt(round(total_ns / 1e9, 9))}"
         )
+
+    if histograms:
+        for family in sorted(histograms):
+            _histogram_lines(tel, family, histograms[family], lines)
 
     lines.append("# HELP sat_up exposition endpoint liveness")
     lines.append("# TYPE sat_up gauge")

@@ -11,7 +11,7 @@ run both windows see the same short history, so a sustained violation
 still alarms before the slow window has fully filled — by design: a run
 that starts bad should page, not grandfather itself in.
 
-Four objective kinds cover the fleet contract (docs/OBSERVABILITY.md):
+Six objective kinds cover the fleet contract (docs/OBSERVABILITY.md):
 
 ``latency_p99``
     pXX of a span's durations inside the window (source: a span name,
@@ -31,6 +31,11 @@ Four objective kinds cover the fleet contract (docs/OBSERVABILITY.md):
     the straggler factor); burn = measured / target.  Instantaneous,
     like ``age_ceiling`` but without the now−stamp subtraction — for
     signals that are already a ratio or level, not a timestamp.
+``gauge_floor``
+    the gauge value vs a MINIMUM (e.g. ``capacity/headroom_pct`` vs the
+    headroom the fleet must keep free); burn = target / measured —
+    burning means the gauge fell below target.  Instantaneous, the
+    floor twin of ``gauge_ceiling``.
 
 Outputs, all riding existing carriers: ``slo/*`` gauges (picked up by
 heartbeat and /metrics), ok↔burning transition records appended to
@@ -60,6 +65,7 @@ KINDS = (
     "rate_floor",
     "age_ceiling",
     "gauge_ceiling",
+    "gauge_floor",
 )
 
 # an objective only evaluates once its window holds this many events
@@ -202,6 +208,11 @@ class SLOEngine:
             if value is None:
                 return None, None
             return float(value), float(value) / obj.target  # sync-ok: host gauge scalar
+        if obj.kind == "gauge_floor":
+            value = self._tel.gauges().get(obj.source)
+            if value is None:
+                return None, None
+            return float(value), obj.target / max(float(value), 1e-9)  # sync-ok: host gauge scalar
         return None, None
 
     # -- evaluation --------------------------------------------------------
@@ -348,6 +359,18 @@ def objectives_from_config(config, phase: str, tenants=()) -> List[Objective]:
                     target=config.slo_error_ratio,
                     source="serve/http_5xx",
                     denom="serve/http_requests",
+                )
+            )
+        if config.slo_capacity_headroom_pct > 0:
+            # capacity plane (telemetry/capacity.py): burn when the
+            # replica's published headroom-% falls below the floor —
+            # paging on approach to the ceiling, before latency melts
+            out.append(
+                Objective(
+                    name="capacity_headroom",
+                    kind="gauge_floor",
+                    target=config.slo_capacity_headroom_pct,
+                    source="capacity/headroom_pct",
                 )
             )
         for name, p99_ms, error_ratio in tenants:

@@ -10,8 +10,8 @@ same ``perf_counter_ns`` clock the telemetry ring uses.  Three outputs:
 
 * ``access.jsonl`` — one structured line per terminal reply (success AND
   sheds), size-capped through :func:`exporters.rotating_append`, holding
-  the trace id, status, bucket, total latency, and all five phase
-  timings.  ``queue_wait + batch_form + dispatch + drain + detok`` are
+  the trace id, status, bucket, tenant, total latency, the attributed
+  device cost (telemetry/metering.py), and all five phase timings.  ``queue_wait + batch_form + dispatch + drain + detok`` are
   disjoint sub-intervals of the request's life, so their sum is ≤ the
   total — the residual is host preprocessing and scheduling gaps.
 * Chrome-trace child spans — :meth:`RequestTracer.trace_events` renders
@@ -130,10 +130,16 @@ class RequestTracer:
         total_ns: int,
         bucket: Optional[int] = None,
         error: Optional[str] = None,
+        tenant: Optional[str] = None,
+        cost: Optional[Any] = None,
     ) -> Dict[str, Any]:
         """Record the terminal reply: one access.jsonl line + retention.
-        Returns the record (tests and callers read it back); never
-        raises — a failed append degrades inside ``rotating_append``."""
+        ``tenant`` stamps the submitting tenant (per-tenant log filtering
+        and Perfetto lane args); ``cost`` is the request's attributed
+        device cost (a ``metering.RequestCost`` — its ms view lands as a
+        ``cost`` sub-object).  Returns the record (tests and callers read
+        it back); never raises — a failed append degrades inside
+        ``rotating_append``."""
         record: Dict[str, Any] = {
             "run_id": run_id(),
             "trace_id": trace.trace_id,
@@ -144,6 +150,10 @@ class RequestTracer:
         }
         if bucket is not None:
             record["bucket"] = int(bucket)
+        if tenant is not None:
+            record["tenant"] = str(tenant)
+        if cost is not None:
+            record["cost"] = cost.as_dict()
         if error:
             record["error"] = error
         with self._lock:
@@ -197,6 +207,10 @@ class RequestTracer:
                         "trace_id": trace.trace_id,
                         "status": record["status"],
                         "bucket": record.get("bucket"),
+                        # tenant + attributed cost ride the lane args so
+                        # Perfetto queries can filter/aggregate by tenant
+                        "tenant": record.get("tenant"),
+                        "cost": record.get("cost"),
                     },
                 }
             )

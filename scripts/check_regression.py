@@ -121,6 +121,12 @@ DEFAULT_MARGINS = {
     # a completion-count fraction over a fixed window, much steadier
     "tenant_isolation_p99_ratio": 30.0,
     "tenant_fair_share_error": 25.0,
+    # metering rows (docs/OBSERVABILITY.md "Cost attribution and tenant
+    # metering"): overhead is a noise-floored microbench-over-p50 ratio
+    # (bench_serve exit-gates the raw value at 0.5% separately); the
+    # would-hit probe is a seeded-Zipf hit fraction, nearly deterministic
+    "metering_overhead_pct": 25.0,
+    "encode_cache_would_hit_ratio": 10.0,
 }
 FALLBACK_MARGIN = 5.0
 
@@ -153,6 +159,10 @@ _HIGHER_BETTER_EXACT = {
     "shard_feed_speedup",
     "min_speedup",
     "fleet_goodput_rps",
+    # a HIGHER would-be hit ratio means caching would pay off more —
+    # the probe regressing toward 0 under the same seeded Zipf traffic
+    # means the sketch (or its crc32c feed) broke
+    "encode_cache_would_hit_ratio",
     "Bleu_4",
     "CIDEr",
     "METEOR",

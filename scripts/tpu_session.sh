@@ -162,6 +162,19 @@ if [ "$rc" -ne 0 ] || [ ! -s "$OUT/tenant_serve.json" ]; then
   FAILED="$FAILED tenant_serve"
 fi
 
+echo "=== stage 1k: cost attribution + capacity probe (metering overhead gate) ==="
+# charge-path microbench priced against the live p50 (hard gate 0.5%),
+# then unique vs Zipf open-loop arms for the would-be encode-cache
+# probe; exits nonzero on overhead over gate, accounting-identity error
+# over 5%, any steady-state recompile, or a dead/false probe
+timeout 900 python scripts/bench_serve.py --metering \
+  2>"$OUT/metering_serve.log" | tee "$OUT/metering_serve.json"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/metering_serve.json" ]; then
+  echo "STAGE FAILED: metering_serve (rc=$rc) — see $OUT/metering_serve.log"
+  FAILED="$FAILED metering_serve"
+fi
+
 echo "=== stage 2: pallas attention measurement ==="
 timeout 1800 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
 rc=${PIPESTATUS[0]}

@@ -1,0 +1,564 @@
+"""Cost attribution, tenant metering and the capacity model (ISSUE 18;
+docs/OBSERVABILITY.md "Cost attribution and tenant metering").
+
+Pins the contracts:
+
+* the **accounting identity** — per-request attributed device time sums
+  to the measured busy-span time within ±5%, under staggered, bursty
+  and multi-tenant admission (unit-simulated over fused windows, and
+  end-to-end over a booted CPU server);
+* the **ledger** — cumulative per-tenant rows, torn-tail tolerant
+  (kill -9 mid-append loses one snapshot of recency, never a
+  double-count), rate-limited flush on an injectable clock;
+* the **capacity model** — headroom/ceiling gauges from windowed deltas,
+  ceiling held across idle windows, busy clamped to [0, 1]; and the
+  encode-cache sketch: >0 would-hit under Zipf-ish repeats, 0 under
+  unique traffic, exact window eviction;
+* the **SLO hook** — the ``gauge_floor`` kind burns when a gauge falls
+  below target (the capacity_headroom objective's comparator);
+* the **exposition** — true Prometheus histograms (cumulative
+  ``_bucket``/``_sum``/``_count``) on /metrics, tenant + cost stamped
+  into access records and Perfetto lane args;
+* **zero steady-state compiles** with metering on — attribution rides
+  already-synced boundaries and adds no shapes.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sat_tpu.telemetry import promtext
+from sat_tpu.telemetry.capacity import CapacityModel, EncodeCacheSketch
+from sat_tpu.telemetry.metering import (
+    MeteringLedger,
+    RequestCost,
+    latest_totals,
+    measured_busy_ms,
+    read_ledger,
+)
+from sat_tpu.telemetry.slo import Objective, SLOEngine
+from sat_tpu.telemetry.spans import Telemetry
+from sat_tpu.telemetry.tracectx import RequestTracer
+
+# ---------------------------------------------------------------------------
+# RequestCost + ledger (pure, jax-free)
+# ---------------------------------------------------------------------------
+
+
+def test_request_cost_accumulates_and_rounds():
+    c = RequestCost()
+    c.add_encode(2_500_000)            # 2.5 ms lane share
+    c.add_decode(1_000_000, steps=4)   # two fused windows
+    c.add_decode(500_000, steps=2)
+    c.set_occupancy(10_000_000)
+    d = c.as_dict()
+    assert d["encode_ms"] == 2.5
+    assert d["decode_ms"] == 1.5
+    assert d["device_ms"] == 4.0
+    assert d["occupancy_ms"] == 10.0
+    assert d["decode_steps"] == 6 and d["dispatches"] == 2
+
+
+def test_ledger_charge_rollup_and_counters():
+    tel = Telemetry(capacity=1024)
+    ledger = MeteringLedger(tel=tel)
+    c = RequestCost()
+    c.add_encode(3_000_000)
+    c.add_decode(1_000_000, steps=5)
+    c.set_occupancy(8_000_000)
+    ledger.charge("pro", cost=c, queue_ms=1.5, detok_ms=0.25)
+    ledger.charge("pro", cost=None, error=True)  # shed: host cost only
+    snap = ledger.snapshot()
+    assert set(snap) == {"pro"}
+    row = snap["pro"]
+    assert row["requests"] == 2 and row["errors"] == 1
+    assert row["device_ms"] == 4.0 and row["occupancy_ms"] == 8.0
+    assert row["queue_ms"] == 1.5 and row["detok_ms"] == 0.25
+    assert row["decode_steps"] == 5 and row["dispatches"] == 1
+    ctr = tel.counters()
+    assert ctr["metering/pro/requests"] == 2
+    assert ctr["metering/pro/device_ms"] == pytest.approx(4.0)
+    assert ledger.attributed_device_ms() == pytest.approx(4.0)
+
+
+def test_ledger_flush_is_rate_limited_and_cumulative(tmp_path):
+    now = [0.0]
+    path = str(tmp_path / "metering.jsonl")
+    ledger = MeteringLedger(path=path, flush_interval_s=5.0,
+                            clock=lambda: now[0])
+    c = RequestCost()
+    c.add_encode(1_000_000)
+    ledger.charge("a", cost=c)
+    assert not os.path.exists(path)  # inside the interval: no append
+    now[0] = 6.0
+    ledger.charge("a", cost=c)
+    rows = read_ledger(path)
+    assert len(rows) == 1  # one cumulative row, not one per charge
+    assert rows[0]["tenant"] == "a" and rows[0]["requests"] == 2
+    now[0] = 12.0
+    ledger.charge("b", cost=c)
+    rows = read_ledger(path)
+    # later rows supersede: replay needs only the last row per tenant
+    totals = latest_totals(rows)
+    assert totals["a"]["requests"] == 2 and totals["b"]["requests"] == 1
+    assert totals["a"]["device_ms"] == pytest.approx(2.0)
+
+
+def test_ledger_read_tolerates_torn_tail_and_garbage(tmp_path):
+    path = str(tmp_path / "metering.jsonl")
+    good1 = json.dumps({"tenant": "a", "requests": 5, "device_ms": 10.0})
+    good2 = json.dumps({"tenant": "a", "requests": 9, "device_ms": 21.0})
+    with open(path, "w") as f:
+        f.write(good1 + "\n")
+        f.write("not json at all\n")
+        f.write(json.dumps(["wrong", "shape"]) + "\n")
+        f.write(json.dumps({"no_tenant": 1}) + "\n")
+        f.write(good2 + "\n")
+        f.write('{"tenant": "a", "requests": 99, "device_')  # torn tail
+    rows = read_ledger(path)
+    assert [r["requests"] for r in rows] == [5, 9]
+    # the torn tail costs exactly one snapshot of recency
+    assert latest_totals(rows)["a"]["requests"] == 9
+
+
+def test_ledger_read_spans_rollover(tmp_path):
+    path = str(tmp_path / "metering.jsonl")
+    with open(path + ".1", "w") as f:
+        f.write(json.dumps({"tenant": "a", "requests": 1}) + "\n")
+    with open(path, "w") as f:
+        f.write(json.dumps({"tenant": "a", "requests": 4}) + "\n")
+    rows = read_ledger(path)
+    assert [r["requests"] for r in rows] == [1, 4]  # oldest first
+    assert read_ledger(str(tmp_path / "missing.jsonl")) == []
+
+
+# ---------------------------------------------------------------------------
+# The accounting identity (unit-simulated admission patterns)
+# ---------------------------------------------------------------------------
+
+
+def _simulate_serving(tel, ledger, pattern):
+    """Replay an admission pattern against the REAL attribution rules:
+    requests submit/retire at step boundaries; each fused window charges
+    every live request dur/n_live; encode chunks charge dur/chunk.
+    ``pattern`` is a list of (tenant, submit_step, n_steps)."""
+    rng = np.random.default_rng(7)
+    costs = {}
+    for i, (tenant, _s, _n) in enumerate(pattern):
+        costs[i] = (tenant, RequestCost())
+    # encode: power-of-two lanes over arrival order, staggered chunks
+    order = sorted(range(len(pattern)), key=lambda i: pattern[i][1])
+    k = 0
+    while k < len(order):
+        chunk = order[k : k + int(rng.choice([1, 2, 4]))]
+        dur = int(rng.integers(200_000, 2_000_000))
+        tel.record("serve/encode", 0, dur)
+        share = dur // len(chunk)
+        for i in chunk:
+            costs[i][1].add_encode(share)
+        k += len(chunk)
+    last_step = max(s + n for _t, s, n in pattern)
+    for step in range(last_step):
+        live = [
+            i for i, (_t, s, n) in enumerate(pattern) if s <= step < s + n
+        ]
+        if not live:
+            continue
+        dur = int(rng.integers(100_000, 1_500_000))
+        tel.record("serve/step", 0, dur)
+        share = dur // len(live)
+        for i in live:
+            costs[i][1].add_decode(share, steps=1)
+    for tenant, cost in costs.values():
+        ledger.charge(tenant, cost=cost)
+
+
+@pytest.mark.parametrize(
+    "pattern",
+    [
+        # staggered: arrivals trickle in, overlapping lifetimes
+        [("a", s, 6) for s in range(0, 20, 2)],
+        # bursty: everyone lands at once, drains at different lengths
+        [("a", 0, n) for n in (2, 3, 5, 8, 13, 21)],
+        # multi-tenant mix, ragged arrivals and lengths
+        [("free", 0, 9), ("free", 1, 4), ("pro", 2, 11),
+         ("pro", 2, 2), ("free", 7, 5), ("pro", 12, 3)],
+    ],
+    ids=["staggered", "bursty", "multi-tenant"],
+)
+def test_accounting_identity_unit(pattern):
+    """Attributed device-ms ≈ measured busy-ms within ±5% — by
+    construction the only slack is integer division truncation, far
+    inside the bound."""
+    tel = Telemetry(capacity=4096)
+    ledger = MeteringLedger(tel=tel)
+    _simulate_serving(tel, ledger, pattern)
+    attributed = ledger.attributed_device_ms()
+    measured = measured_busy_ms(tel)
+    assert measured > 0
+    assert abs(attributed - measured) <= 0.05 * measured
+
+
+# ---------------------------------------------------------------------------
+# Encode-cache sketch + capacity model
+# ---------------------------------------------------------------------------
+
+
+def test_sketch_window_eviction_and_refcounts():
+    s = EncodeCacheSketch(window=2)
+    # key 1 repeats inside the window (hit), then 2 and 3 push it out of
+    # the 2-entry window, so its return is a miss — exactly what a
+    # 2-entry cache would have scored
+    assert [s.observe(k) for k in (1, 1, 2, 3, 1)] == [
+        False, True, False, False, False,
+    ]
+    assert s.lookups == 5 and s.hits == 1
+    assert s.ratio() == pytest.approx(0.2)
+
+
+def test_sketch_zipf_hits_unique_misses():
+    rng = np.random.default_rng(0)
+    zipf = EncodeCacheSketch(window=256)
+    ranks = np.arange(1, 65)
+    p = (1.0 / ranks) / (1.0 / ranks).sum()
+    for key in rng.choice(ranks, size=500, p=p):
+        zipf.observe(int(key))
+    assert zipf.ratio() > 0.5  # heavy head repeats inside the window
+    unique = EncodeCacheSketch(window=256)
+    for key in range(500):
+        unique.observe(key)
+    assert unique.ratio() == 0.0
+
+
+def test_capacity_model_headroom_ceiling_and_idle_hold():
+    tel = Telemetry(capacity=1024)
+    ledger = MeteringLedger(tel=tel)
+    now = [0.0]
+    model = CapacityModel(tel, ledger, slots=4, interval_s=1.0,
+                          clock=lambda: now[0])
+    # window 1: 4 requests, 2000 ms occupancy over 4 slots x 1 s => 50%
+    for _ in range(4):
+        c = RequestCost()
+        c.set_occupancy(int(500e6))
+        ledger.charge("a", cost=c)
+    now[0] = 1.0
+    model.maybe_update()
+    g = tel.gauges()
+    assert g["capacity/slot_busy_ratio"] == pytest.approx(0.5)
+    assert g["capacity/headroom_pct"] == pytest.approx(50.0)
+    # ceiling: slots * d_req / d_occ_s = 4 * 4 / 2.0 = 8 captions/s
+    assert g["capacity/ceiling_captions_per_s"] == pytest.approx(8.0)
+    assert g["capacity/completed_per_s"] == pytest.approx(4.0)
+    # idle window: busy drops to 0, headroom to 100 — but the last known
+    # ceiling holds (an idle replica still has a known capacity)
+    now[0] = 2.0
+    model.maybe_update()
+    g = tel.gauges()
+    assert g["capacity/slot_busy_ratio"] == 0.0
+    assert g["capacity/headroom_pct"] == 100.0
+    assert g["capacity/ceiling_captions_per_s"] == pytest.approx(8.0)
+    # saturated window clamps busy at 1.0 (occupancy credits at retire)
+    for _ in range(20):
+        c = RequestCost()
+        c.set_occupancy(int(1e9))
+        ledger.charge("a", cost=c)
+    now[0] = 3.0
+    model.maybe_update()
+    g = tel.gauges()
+    assert g["capacity/slot_busy_ratio"] == 1.0
+    assert g["capacity/headroom_pct"] == 0.0
+
+
+def test_capacity_model_rate_limit_and_sketch_gauge():
+    tel = Telemetry(capacity=1024)
+    ledger = MeteringLedger(tel=tel)
+    sketch = EncodeCacheSketch(window=8)
+    now = [0.0]
+    model = CapacityModel(tel, ledger, slots=2, interval_s=1.0,
+                          sketch=sketch, clock=lambda: now[0])
+    now[0] = 0.5
+    model.maybe_update()  # inside the interval: publishes nothing
+    assert "capacity/headroom_pct" not in tel.gauges()
+    sketch.observe(1)
+    sketch.observe(1)
+    now[0] = 1.5
+    model.maybe_update()
+    g = tel.gauges()
+    assert g["capacity/headroom_pct"] == 100.0
+    assert g["capacity/encode_cache_would_hit_ratio"] == pytest.approx(0.5)
+
+
+def test_gauge_floor_kind_burns_below_target():
+    tel = Telemetry(capacity=1024)
+    engine = SLOEngine(
+        tel,
+        [Objective(name="capacity_headroom", kind="gauge_floor",
+                   target=20.0, source="capacity/headroom_pct")],
+    )
+    tel.gauge("capacity/headroom_pct", 80.0)
+    res = engine.tick()["capacity_headroom"]
+    assert res["burning"] is False
+    assert res["burn_fast"] == pytest.approx(0.25)
+    tel.gauge("capacity/headroom_pct", 5.0)
+    res = engine.tick()["capacity_headroom"]
+    assert res["burning"] is True
+    assert res["measured_fast"] == 5.0
+    assert tel.gauges()["slo/capacity_headroom_burning"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Exposition: histograms + tenant/cost stamping
+# ---------------------------------------------------------------------------
+
+
+def test_promtext_histogram_cumulative_buckets():
+    tel = Telemetry(capacity=1024)
+    for ms in (1, 5, 20, 200, 2000):
+        tel.record("serve/request", 0, int(ms * 1e6))
+    text = promtext.render(
+        tel,
+        histograms={
+            "sat_request_latency_seconds": (
+                "serve/request", (0.01, 0.1, 1.0), 1e-9
+            )
+        },
+    )
+    lines = dict(
+        line.rsplit(" ", 1)
+        for line in text.splitlines()
+        if line.startswith("sat_request_latency_seconds")
+    )
+    assert lines['sat_request_latency_seconds_bucket{le="0.01"}'] == "2"
+    assert lines['sat_request_latency_seconds_bucket{le="0.1"}'] == "3"
+    assert lines['sat_request_latency_seconds_bucket{le="1.0"}'] == "4"
+    assert lines['sat_request_latency_seconds_bucket{le="+Inf"}'] == "5"
+    assert lines["sat_request_latency_seconds_count"] == "5"
+    assert float(lines["sat_request_latency_seconds_sum"]) == pytest.approx(
+        2.226
+    )
+    assert "# TYPE sat_request_latency_seconds histogram" in text
+
+
+def test_tracer_stamps_tenant_and_cost(tmp_path):
+    path = str(tmp_path / "access.jsonl")
+    tracer = RequestTracer(path=path)
+    trace = tracer.begin()
+    cost = RequestCost()
+    cost.add_encode(2_000_000)
+    cost.add_decode(1_000_000, steps=3)
+    record = tracer.finish(
+        trace, 200, int(5e6), bucket=2, tenant="pro", cost=cost
+    )
+    assert record["tenant"] == "pro"
+    assert record["cost"]["device_ms"] == 3.0
+    with open(path) as f:
+        on_disk = json.loads(f.readline())
+    assert on_disk["tenant"] == "pro" and on_disk["cost"]["decode_steps"] == 3
+    lane = [
+        e for e in tracer.trace_events(anchor_ns=0)
+        if e.get("cat") == "request" and e["name"].startswith("request ")
+    ][0]
+    assert lane["args"]["tenant"] == "pro"
+    assert lane["args"]["cost"]["device_ms"] == 3.0
+    # absent tenant/cost: fields stay out of the record (schema-stable)
+    bare = tracer.finish(tracer.begin(), 200, int(1e6))
+    assert "tenant" not in bare and "cost" not in bare
+
+
+# ---------------------------------------------------------------------------
+# End-to-end on a booted CPU server (batch mode, tiny model)
+# ---------------------------------------------------------------------------
+
+TINY_MODEL = dict(
+    phase="serve",
+    image_size=32,
+    dim_embedding=16,
+    num_lstm_units=16,
+    dim_initialize_layer=16,
+    dim_attend_layer=16,
+    dim_decode_layer=32,
+    compute_dtype="float32",
+    beam_size=2,
+    serve_buckets=(1, 2),
+    serve_max_batch=2,
+    serve_max_wait_ms=10.0,
+    serve_queue_depth=8,
+    heartbeat_interval=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    import cv2
+    import jax
+
+    from sat_tpu import runtime, telemetry
+    from sat_tpu.config import Config
+    from sat_tpu.data.vocabulary import Vocabulary
+    from sat_tpu.resilience import lineage
+    from sat_tpu.serve.engine import ServeEngine, load_serving_state
+    from sat_tpu.train.checkpoint import save_checkpoint
+    from sat_tpu.train.step import create_train_state
+
+    root = str(tmp_path_factory.mktemp("metering"))
+    vocab_file = os.path.join(root, "vocabulary.csv")
+    vocabulary = Vocabulary(size=30)
+    vocabulary.build(["a man riding a horse.", "a cat on a table."])
+    vocabulary.save(vocab_file)
+    config = Config(
+        **TINY_MODEL,
+        vocabulary_size=vocabulary.size,
+        vocabulary_file=vocab_file,
+        save_dir=os.path.join(root, "models"),
+        summary_dir=os.path.join(root, "summary"),
+    )
+    os.makedirs(config.save_dir, exist_ok=True)
+    tel = telemetry.enable(capacity=16384)
+    runtime._install_compile_listener()
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    save_checkpoint(state, config)
+    lineage.mark_last_good(config.save_dir, int(np.asarray(state.step)))
+    state, _source = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+
+    rng = np.random.default_rng(0)
+    jpegs = []
+    for i in range(4):
+        img = rng.integers(0, 255, (32, 32, 3), dtype=np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        jpegs.append(bytes(buf))
+    yield {"config": config, "engine": engine, "tel": tel, "jpegs": jpegs}
+    telemetry.disable()
+
+
+def _boot(stack, **overrides):
+    from sat_tpu.serve.server import CaptionServer
+
+    config = stack["config"].replace(**overrides)
+    return CaptionServer(config, stack["engine"], port=0).start()
+
+
+def _post(port, data, headers=None, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/caption",
+        data=data,
+        method="POST",
+        headers={"Content-Type": "image/jpeg", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return r.status, r.read().decode()
+
+
+def test_e2e_identity_stats_metrics_and_zero_compiles(stack):
+    """The acceptance pin, end-to-end: bursty multi-tenant traffic on a
+    booted server yields per-tenant cost rows whose device-ms sums match
+    the measured busy-span delta within ±5%, shows up on /stats and
+    /metrics (histograms included), stamps access records — all with
+    ZERO steady-state compiles and metering on."""
+    tel, jpegs = stack["tel"], stack["jpegs"]
+    server = _boot(stack, tenants="alpha:2,beta:1")
+    try:
+        assert server.metering is not None and server.capacity is not None
+        status, _payload = _post(server.port, jpegs[0])  # warm the path
+        assert status == 200
+        compiles0 = tel.counters().get("jax/compiles", 0)
+        busy0 = measured_busy_ms(tel)
+        attr0 = server.metering.attributed_device_ms()
+
+        results = []
+
+        def _one(i):
+            tenant = "alpha" if i % 3 else "beta"
+            results.append(
+                _post(server.port, jpegs[i % len(jpegs)],
+                      headers={"X-Tenant": tenant})[0]
+            )
+
+        threads = [
+            threading.Thread(target=_one, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results.count(200) == 8
+
+        # the accounting identity over exactly this burst
+        attributed = server.metering.attributed_device_ms() - attr0
+        measured = measured_busy_ms(tel) - busy0
+        assert measured > 0
+        assert abs(attributed - measured) <= 0.05 * measured
+
+        # zero steady-state compiles with metering on
+        assert tel.counters().get("jax/compiles", 0) == compiles0
+
+        # /stats: tenants_cost rows + the capacity block
+        _s, raw = _get(server.port, "/stats")
+        stats = json.loads(raw)
+        cost_block = stats["tenants_cost"]
+        assert set(cost_block) >= {"alpha", "beta"}
+        assert cost_block["alpha"]["requests"] >= 5
+        assert cost_block["alpha"]["device_ms"] > 0
+        assert cost_block["beta"]["dispatches"] >= 1
+        assert stats["capacity"]["headroom_pct"] <= 100.0
+        assert "ceiling_captions_per_s" in stats["capacity"]
+        # scheduler admissions ride the tenants block for reconciliation
+        assert stats["tenants"]["alpha"]["admitted"] >= 5
+
+        # /metrics: metering counters + true histogram families
+        _s, text = _get(server.port, "/metrics")
+        assert 'sat_counter_total{name="metering/alpha/device_ms"}' in text
+        assert 'sat_gauge{name="capacity/headroom_pct"}' in text
+        assert "# TYPE sat_request_latency_seconds histogram" in text
+        assert 'sat_request_latency_seconds_bucket{le="+Inf"}' in text
+        assert "sat_request_latency_seconds_count" in text
+
+        # access records carry tenant + cost
+        recs = [
+            r for r in server.tracer.finished()
+            if r.get("tenant") == "beta"
+        ]
+        assert recs and recs[-1]["cost"]["device_ms"] > 0
+
+        # the ledger flushed (shutdown forces the tail below)
+        server.metering.maybe_flush(force=True)
+        tdir = server.config.telemetry_dir or os.path.join(
+            server.config.summary_dir, "telemetry"
+        )
+        ledger_rows = read_ledger(os.path.join(tdir, "metering.jsonl"))
+        totals = latest_totals(ledger_rows)
+        assert totals["alpha"]["schema"] == 1
+        assert totals["alpha"]["requests"] == cost_block["alpha"]["requests"]
+    finally:
+        server.shutdown()
+
+
+def test_e2e_metering_off_knob(stack):
+    """--serve_metering off: no ledger, no capacity gauges, /stats has
+    no tenants_cost block — the pre-metering schema, unchanged."""
+    server = _boot(stack, serve_metering=False)
+    try:
+        assert server.metering is None and server.capacity is None
+        status, _payload = _post(server.port, stack["jpegs"][0])
+        assert status == 200
+        stats = json.loads(_get(server.port, "/stats")[1])
+        assert "tenants_cost" not in stats and "capacity" not in stats
+    finally:
+        server.shutdown()

@@ -792,8 +792,11 @@ def test_e2e_slo_burn_degrades_health(obs_served, monkeypatch, tmp_path):
     config = obs_served["config"].replace(
         telemetry_dir=str(tmp_path / "slo_tel"),
         slo_serve_p99_ms=5.0,       # every request will violate this
-        slo_window_fast_s=0.6,
-        slo_window_slow_s=1.2,
+        # windows sized for slow boxes: a serial closed loop at
+        # ~250ms/request must still land MIN_EVENTS samples inside the
+        # fast window, or p99 never measures and burning can't flip
+        slo_window_fast_s=2.0,
+        slo_window_slow_s=4.0,
     )
     # the batcher captures its FaultPlan at construction: arm BEFORE
     monkeypatch.setenv("SAT_FI_SLOW_SERVE_MS", "50")

@@ -458,21 +458,27 @@ def test_tenant_quota_shed_contract(stack):
     """Over-quota requests shed 429 with X-Shed-Scope: tenant and a
     never-0s Retry-After from THAT bucket's refill; the unlimited
     tenant is untouched and the shed shows up in the per-tenant
-    counters."""
+    counters.  The bucket clocks freeze right after boot (the
+    ``use_clock`` test hook), so the outcome is deterministic — on a
+    loaded box slow serial requests used to refill the 0.2/s bucket
+    mid-loop and the shed count depended on wall time."""
     jpeg, tel = stack["jpeg"], stack["tel"]
     shed0 = tel.counters().get("serve/tenant_capped_shed", 0)
     server = _boot(stack, tenants="free:4,capped:1:0.2:2")
     try:
+        server.tenants.use_clock(lambda: 0.0)  # no refill from here on
         outcomes = [
             _post(server.port, jpeg, headers={"X-Tenant": "capped"})
             for _ in range(4)
         ]
         sheds = [(s, p, h) for s, p, h in outcomes if s == 429]
-        assert len(sheds) >= 1  # burst 2, refill 0.2/s: the tail sheds
-        assert all(s in (200, 429) for s, _p, _h in outcomes)
+        # burst 2, frozen clock: exactly the first two admit, tail sheds
+        assert [s for s, _p, _h in outcomes] == [200, 200, 429, 429]
+        assert len(sheds) == 2
         for _s, payload, headers in sheds:
             assert payload["shed_scope"] == "tenant"
-            assert payload["retry_after_ms"] >= 1
+            # a dry bucket at 0.2 tokens/s: 5s to the next whole token
+            assert payload["retry_after_ms"] == 5001
             assert "capped" in payload["error"]
             assert headers["X-Shed-Scope"] == "tenant"
             assert int(headers["Retry-After"]) >= 1
