@@ -166,11 +166,20 @@ def _decode_shard(
     caption lists (row order) and the advanced pool-step counter (the
     fault-injection clock — ``SAT_FI_DIE_AT_STEP`` counts decode steps
     across shards, so the counter advances by the steps actually run in
-    each window, keeping the chaos clock step-denominated)."""
+    each window, keeping the chaos clock step-denominated).  With the
+    quality plane on (``--serve_quality on``) each row also gets the
+    flywheel's curation signals (margin / normalized log-prob / unk
+    rate / coverage deviation) — pure host arithmetic on the already-
+    drained harvest arrays, rounded so output stays bitwise
+    deterministic; off leaves the output bytes untouched."""
     from ..serve.batcher import choose_decode_depth
+    from ..telemetry.quality import extract_signals
 
+    want_quality = engine.config.serve_quality == "on"
+    vocab_size = len(engine.vocabulary.words)
     n = batch.shape[0]
     results: List[Any] = [None] * n
+    quality_rows: List[Any] = [None] * n
     submitted = 0
     harvested = 0
     while harvested < n:
@@ -191,13 +200,29 @@ def _decode_shard(
         done_host = np.asarray(done)  # sync-ok: stepped-decode drain boundary, whole-array transfer
         step_counter += int(np.asarray(steps_dev))  # sync-ok: same drain boundary as the done flags
         if done_host.any():
-            payloads, words, lengths, scores, _steps = pool.harvest(done_host)
+            payloads, words, lengths, scores, _steps, alphas = pool.harvest(
+                done_host
+            )
             if payloads:
                 rows = engine.detok_rows((words, lengths, scores), len(payloads))
-                for payload, row in zip(payloads, rows):
+                for j, (payload, row) in enumerate(zip(payloads, rows)):
                     results[payload] = row["captions"]
+                    if want_quality:
+                        sig = extract_signals(
+                            words[j], lengths[j], scores[j],
+                            vocab_size=vocab_size, eos_id=engine.eos_id,
+                            alphas=None if alphas is None else alphas[j],
+                        )
+                        quality_rows[payload] = {
+                            k: round(sig[k], 6)
+                            for k in (
+                                "margin", "norm_logprob", "unk_rate",
+                                "coverage_dev",
+                            )
+                            if k in sig
+                        }
                     harvested += 1
-    return results, step_counter
+    return results, quality_rows, step_counter
 
 
 def run_bulk(config: Config, model_file: Optional[str] = None) -> int:
@@ -367,7 +392,7 @@ def run_bulk(config: Config, model_file: Optional[str] = None) -> int:
                             shard_files, engine, cache, quarantine,
                             config.num_data_workers,
                         )
-                    results, step_counter = _decode_shard(
+                    results, qrows, step_counter = _decode_shard(
                         engine, pool, batch, fp, wd, step_counter
                     )
                     with wd.phase("checkpoint"):
@@ -375,6 +400,11 @@ def run_bulk(config: Config, model_file: Optional[str] = None) -> int:
                         try:
                             for i, f in enumerate(shard_files):
                                 row = {"file": f, "captions": results[i]}
+                                if qrows[i] is not None:
+                                    # flywheel curation signals; keyed
+                                    # fields only, rounded at extraction
+                                    # so the bytes stay deterministic
+                                    row["quality"] = qrows[i]
                                 row.update(meta.get(i, ()))
                                 writer.write_row(row)
                             fname, rows, crc = writer.finish()

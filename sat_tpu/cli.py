@@ -249,6 +249,36 @@ def build_config(argv: Optional[List[str]] = None):
              "default Config.serve_metering=True",
     )
     p.add_argument(
+        "--serve_quality", choices=("on", "off"), default=None,
+        help="serve phase: caption-quality observability plane — "
+             "per-request quality signals at the detok boundary, "
+             "streaming PSI drift vs a frozen reference, exemplar "
+             "flight recorder + bitwise replay (telemetry/quality.py, "
+             "telemetry/exemplar.py; docs/OBSERVABILITY.md 'Caption "
+             "quality'). Default Config.serve_quality='off' — off is "
+             "bit-identical to the pre-quality serve path",
+    )
+    p.add_argument(
+        "--quality_reference", default=None, metavar="JSON",
+        help="serve phase: quality_reference.json to load as the frozen "
+             "drift reference (exported by GET /quality_reference); "
+             "default '' freezes the reference from the first "
+             "serve_quality_window live requests",
+    )
+    p.add_argument(
+        "--slo_quality_psi", type=float, default=None, metavar="PSI",
+        help="serve phase: quality_drift SLO lane — gauge_ceiling over "
+             "quality/psi_max (population-stability drift score); "
+             "diagnostic like tenant lanes (/healthz stays ok while it "
+             "burns); 0 disables; default Config.slo_quality_psi=0",
+    )
+    p.add_argument(
+        "--slo_quality_unk", type=float, default=None, metavar="RATE",
+        help="serve phase: quality_unk SLO lane — gauge_ceiling over the "
+             "windowed quality/unk_rate; 0 disables; default "
+             "Config.slo_quality_unk=0",
+    )
+    p.add_argument(
         "--slo_capacity_headroom_pct", type=float, default=None,
         metavar="PCT",
         help="serve phase: capacity_headroom SLO objective — alert when "
@@ -426,6 +456,14 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(tenants=args.tenants)
     if args.serve_metering is not None:
         config = config.replace(serve_metering=args.serve_metering == "on")
+    if args.serve_quality is not None:
+        config = config.replace(serve_quality=args.serve_quality)
+    if args.quality_reference is not None:
+        config = config.replace(serve_quality_reference=args.quality_reference)
+    if args.slo_quality_psi is not None:
+        config = config.replace(slo_quality_psi=args.slo_quality_psi)
+    if args.slo_quality_unk is not None:
+        config = config.replace(slo_quality_unk=args.slo_quality_unk)
     if args.slo_capacity_headroom_pct is not None:
         config = config.replace(
             slo_capacity_headroom_pct=args.slo_capacity_headroom_pct

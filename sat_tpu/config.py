@@ -260,6 +260,41 @@ class Config:
     # active when telemetry is on (all attribution rides telemetry-gated
     # already-synced boundaries); off skips ledger and gauges entirely.
     serve_metering: bool = True
+    # ---- caption-quality observability (telemetry/quality.py, ----
+    # ---- telemetry/exemplar.py; docs/OBSERVABILITY.md "Quality") ----
+    # "on" threads the harvested beam alphas through the existing detok
+    # boundary (same drains, zero extra syncs), extracts per-request
+    # quality signals host-side, streams them into fixed-bin drift
+    # sketches (PSI vs a frozen reference) and tail-samples outlier
+    # requests into the exemplar flight recorder.  "off" (default) keeps
+    # the serve path bit-identical to the pre-quality plane, including
+    # the warmed executables (return_alphas stays False).
+    serve_quality: str = "off"
+    # rotating window length per signal sketch; the frozen reference is
+    # captured from the first window of traffic when no reference file
+    # is given
+    serve_quality_window: int = 256
+    # quality_reference.json to load as the frozen drift reference ("" =
+    # freeze from the first serve_quality_window requests at runtime);
+    # export the live reference with GET /quality_reference
+    serve_quality_reference: str = ""
+    # exemplar flight-recorder directory ("" = <telemetry_dir>/exemplars)
+    serve_quality_exemplar_dir: str = ""
+    # recorder disk budget (segments + image payloads, MB); oldest
+    # segments rotate out first
+    serve_quality_exemplar_mb: float = 64.0
+    # outlier triggers: a request whose beam margin (top1 - top2
+    # log-prob) falls below margin_min, or whose unk/OOV token rate
+    # exceeds unk_max, is captured (margin_min 0 / unk_max 1 = trigger
+    # off; shed/timeout capture is always armed while the plane is on)
+    serve_quality_margin_min: float = 0.0
+    serve_quality_unk_max: float = 1.0
+    # quality SLO lanes (gauge_ceiling; diagnostic like tenant lanes —
+    # they burn without flipping /healthz): PSI drift-score ceiling over
+    # quality/psi_max and windowed unk-rate ceiling over
+    # quality/unk_rate.  0 = lane off.
+    slo_quality_psi: float = 0.0
+    slo_quality_unk: float = 0.0
 
     # ---- model lifecycle (sat_tpu/lifecycle; docs/SERVING.md) ----
     # zero-downtime model refresh: a reloader thread polls the lineage
@@ -557,6 +592,36 @@ class Config:
         if self.serve_slot_pages <= 0 or self.serve_page_width <= 0:
             raise ValueError(
                 "Config.serve_slot_pages and serve_page_width must be >= 1"
+            )
+        if self.serve_quality not in ("off", "on"):
+            raise ValueError(
+                f"Config.serve_quality={self.serve_quality!r}: must be "
+                "'off' or 'on'"
+            )
+        if self.serve_quality_window < 8:
+            raise ValueError(
+                f"Config.serve_quality_window={self.serve_quality_window}: "
+                "must be >= 8 (a drift sketch needs a real window)"
+            )
+        if self.serve_quality_exemplar_mb <= 0:
+            raise ValueError(
+                "Config.serve_quality_exemplar_mb must be > 0"
+            )
+        if self.serve_quality_margin_min < 0:
+            raise ValueError(
+                "Config.serve_quality_margin_min must be >= 0 (0 = off)"
+            )
+        if not 0 <= self.serve_quality_unk_max <= 1:
+            raise ValueError(
+                "Config.serve_quality_unk_max must be in [0, 1] (1 = off)"
+            )
+        if self.slo_quality_psi < 0:
+            raise ValueError(
+                "Config.slo_quality_psi must be >= 0 (0 = lane off)"
+            )
+        if not 0 <= self.slo_quality_unk <= 1:
+            raise ValueError(
+                "Config.slo_quality_unk must be in [0, 1] (0 = lane off)"
             )
         depths = tuple(self.serve_decode_depth)
         if depths != self.serve_decode_depth:

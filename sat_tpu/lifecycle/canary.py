@@ -11,14 +11,29 @@ and the candidate's captions for the SAME image (shadow-sampled by the
 controller): 0 = identical token sets, 1 = disjoint.  It is the cheap
 "did the model change what it says" signal that p99/error-rate SLOs
 cannot see — a candidate can be fast, error-free, and caption every
-image as "a a a a".  Jax-free: the lifecycle control plane imports
-this module in the router and in jax-free tooling.
+image as "a a a a".  The implementation lives in
+:mod:`sat_tpu.telemetry.quality` (one quality module serves both the
+canary gate and the steady-state drift plane); this module re-exports
+``caption_divergence`` / ``DivergenceGauge`` for its existing callers.
+Jax-free: the lifecycle control plane imports this module in the
+router and in jax-free tooling.
 """
 
 from __future__ import annotations
 
 import hashlib
 from typing import Optional
+
+from ..telemetry.quality import DivergenceGauge, caption_divergence
+
+__all__ = [
+    "INCUMBENT",
+    "CANARY",
+    "request_weight",
+    "assign_slot",
+    "caption_divergence",
+    "DivergenceGauge",
+]
 
 INCUMBENT = "incumbent"
 CANARY = "canary"
@@ -44,35 +59,3 @@ def assign_slot(request_id: Optional[str], fraction: float) -> str:
     if fraction >= 1:
         return CANARY
     return CANARY if request_weight(request_id) < fraction else INCUMBENT
-
-
-def caption_divergence(incumbent: str, candidate: str) -> float:
-    """Token Jaccard distance between two captions in [0, 1]."""
-    a = set(incumbent.split())
-    b = set(candidate.split())
-    if not a and not b:
-        return 0.0
-    union = a | b
-    if not union:
-        return 0.0
-    return 1.0 - len(a & b) / len(union)
-
-
-class DivergenceGauge:
-    """EWMA of shadow-pair divergences; one float of state, no locks
-    needed beyond the GIL (single shadow worker updates it)."""
-
-    def __init__(self, alpha: float = 0.3) -> None:
-        self.alpha = float(alpha)  # sync-ok: host config scalar
-        self.value: Optional[float] = None
-        self.samples = 0
-
-    def update(self, divergence: float) -> float:
-        d = min(1.0, max(0.0, float(divergence)))  # sync-ok: host scalar
-        self.value = (
-            d
-            if self.value is None
-            else self.alpha * d + (1 - self.alpha) * self.value
-        )
-        self.samples += 1
-        return self.value

@@ -74,6 +74,17 @@ Knobs::
                                (its word_idxs/masks zeroed) so the
                                caption-anomaly detector must quarantine
                                it
+    SAT_FI_QUALITY_SKEW=c      depress every drained top-beam log score
+                               by c/100 at the serve-path detok boundary
+                               (harvest-side scoring only — caption
+                               TOKENS are untouched, so replay stays
+                               bitwise).  Beam margins and normalized
+                               log-probs shift together, exactly like a
+                               quietly degraded checkpoint: the quality
+                               drift lane must burn while /healthz stays
+                               ok.  Re-read from the environment per
+                               drain so a chaos scenario can arm it
+                               mid-run
 """
 
 from __future__ import annotations
@@ -341,6 +352,18 @@ def consume_decode_fault(image_file: str) -> None:
             f"injected decode failure (SAT_FI_BAD_IMAGE_EVERY={n}): "
             f"{image_file}"
         )
+
+
+def consume_quality_skew() -> float:
+    """Called by the serve batchers at every detok boundary.  Inert (one
+    env get) unless ``SAT_FI_QUALITY_SKEW`` is set; then returns the log
+    score depression (``c / 100``) the drained top beam must absorb.
+    Env-read per call — NOT captured into the batcher's FaultPlan — so
+    the chaos campaign can flip drift on under live load."""
+    spec = os.environ.get(ENV_PREFIX + "QUALITY_SKEW")
+    if not spec:
+        return 0.0
+    return int(spec) / 100.0
 
 
 def consume_caption_fault() -> bool:

@@ -124,7 +124,17 @@ class Watchdog:
         self._dumped_at: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # every deadline comparison reads this clock; tests swap in a
+        # fake (use_clock) so stall/no-stall scenarios are exact instead
+        # of racing wall time under suite load (the TenantRegistry
+        # injectable-clock pattern)
+        self._clock = time.monotonic
         self._tel.gauge("watchdog/state", OK)
+
+    def use_clock(self, clock: Callable[[], float]) -> "Watchdog":
+        """Swap the monotonic time source (tests only)."""
+        self._clock = clock
+        return self
 
     # -- instrumentation (called from watched threads) ---------------------
 
@@ -132,7 +142,7 @@ class Watchdog:
         return _PhaseGuard(self, name)
 
     def _enter(self, name: str) -> None:
-        self._active[name] = time.monotonic()
+        self._active[name] = self._clock()
 
     def _exit(self, name: str) -> None:
         self._active.pop(name, None)
@@ -165,7 +175,7 @@ class Watchdog:
 
     def _overdue(self) -> Optional[tuple]:
         """(phase, seconds overdue) of the worst enforced open phase."""
-        now = time.monotonic()
+        now = self._clock()
         worst = None
         for name, t0 in list(self._active.items()):
             deadline = self.deadlines.get(name)
@@ -209,12 +219,12 @@ class Watchdog:
             return
         if self.state == STALLED:
             self.state = DUMPED
-            self._dumped_at = time.monotonic()
+            self._dumped_at = self._clock()
             self._tel.gauge("watchdog/state", DUMPED)
             self._dump(name, over)
             return
         if self.state == DUMPED and (
-            time.monotonic() - (self._dumped_at or 0.0) >= self.grace_s
+            self._clock() - (self._dumped_at or 0.0) >= self.grace_s
         ):
             self.state = ABORTING
             self._tel.gauge("watchdog/state", ABORTING)

@@ -114,6 +114,10 @@ class Request:
     # it on already-synced boundaries and the server's terminal funnel
     # folds it into the tenant ledger.  None with telemetry off.
     cost: Optional[RequestCost] = None
+    # raw POSTed image bytes, kept ONLY when the quality plane is on so
+    # the exemplar flight recorder can store a replayable copy of an
+    # outlier request; None otherwise (no per-request body retention)
+    raw: Optional[bytes] = None
 
     def mark(self, phase: str, t0_ns: int, dur_ns: int) -> None:
         if self.trace is not None:
@@ -136,6 +140,8 @@ class _BatcherBase:
         on_wedge: Optional[Callable[[], None]] = None,
         wedge_timeout_ms: Optional[float] = None,
         weights: Optional[Dict[str, float]] = None,
+        quality=None,
+        exemplars=None,
     ) -> None:
         config = engine.config
         self.engine = engine
@@ -164,6 +170,11 @@ class _BatcherBase:
         # captured once so the fire-once bookkeeping persists across
         # batches
         self._plan = faultinject.FaultPlan.from_env()
+        # quality plane (telemetry/quality.py): a QualityMonitor and an
+        # ExemplarRecorder, both None with --serve_quality off — every
+        # quality hook below is then a single attribute compare
+        self._quality = quality
+        self._exemplars = exemplars
         self._draining = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # lifecycle control commands (arm_canary / swap / disarm_canary)
@@ -183,6 +194,7 @@ class _BatcherBase:
         trace: Optional[Any] = None,
         slot: str = "incumbent",
         tenant: str = "default",
+        raw: Optional[bytes] = None,
     ) -> Request:
         """Admit one preprocessed image; raises Rejected(503) while
         draining and Rejected(429) when the tenant's queue lane is full
@@ -199,6 +211,9 @@ class _BatcherBase:
             slot=slot,
             tenant=tenant,
             cost=RequestCost() if self._tel.enabled else None,
+            # body bytes are retained only while this request is in
+            # flight AND the quality plane wants exemplars
+            raw=raw if self._exemplars is not None else None,
         )
         try:
             self._q.put_nowait(req)
@@ -332,6 +347,60 @@ class _BatcherBase:
             raise box["error"]
         return box["results"]
 
+    # -- quality plane (telemetry/quality.py) ------------------------------
+
+    def _apply_quality_skew(self, scores: np.ndarray) -> np.ndarray:
+        """SAT_FI_QUALITY_SKEW: depress the drained top-beam log scores
+        by the armed amount — margins and normalized log-probs shift like
+        a quietly degraded checkpoint while caption TOKENS stay bitwise
+        identical (so exemplar replay still reproduces).  Env-read per
+        drain (not via the construction-time FaultPlan) so the chaos
+        campaign can arm it against a live server; inert path is one env
+        get."""
+        skew = faultinject.consume_quality_skew()
+        if skew and scores.size:
+            scores = scores.copy()
+            scores[:, 0] -= skew
+        return scores
+
+    def _observe_quality(
+        self, payloads, words, lengths, scores, alphas, results
+    ) -> None:
+        """Per-request quality signals at the detok boundary — pure host
+        arithmetic on arrays the drain already synced (zero new device
+        syncs).  Outliers flagged by the monitor are handed to the
+        exemplar flight recorder; any failure here is counted and
+        swallowed (observability must never fail a request)."""
+        if self._quality is None:
+            return
+        from ..telemetry.quality import extract_signals
+
+        vocab_size = len(self.engine.vocabulary.words)
+        eos_id = self.engine.eos_id
+        try:
+            for i, r in enumerate(payloads):
+                sig = extract_signals(
+                    words[i], lengths[i], scores[i],
+                    vocab_size=vocab_size, eos_id=eos_id,
+                    alphas=None if alphas is None else alphas[i],
+                )
+                reasons = self._quality.observe(sig, tenant=r.tenant)
+                if reasons and self._exemplars is not None:
+                    captions = results[i]["captions"] if results else []
+                    self._exemplars.record(
+                        reasons=reasons,
+                        request_id=getattr(r.trace, "trace_id", ""),
+                        tenant=r.tenant,
+                        caption=captions[0]["caption"] if captions else "",
+                        beams=captions,
+                        signals=sig,
+                        image_bytes=r.raw,
+                        alphas=None if alphas is None else alphas[i],
+                        extra={"slot": r.slot, "bucket": r.bucket},
+                    )
+        except Exception:
+            self._tel.count("serve/quality_errors")
+
 
 class MicroBatcher(_BatcherBase):
     def __init__(
@@ -345,6 +414,8 @@ class MicroBatcher(_BatcherBase):
         on_wedge: Optional[Callable[[], None]] = None,
         wedge_timeout_ms: Optional[float] = None,
         weights: Optional[Dict[str, float]] = None,
+        quality=None,
+        exemplars=None,
     ) -> None:
         super().__init__(
             engine,
@@ -353,6 +424,8 @@ class MicroBatcher(_BatcherBase):
             on_wedge=on_wedge,
             wedge_timeout_ms=wedge_timeout_ms,
             weights=weights,
+            quality=quality,
+            exemplars=exemplars,
         )
         config = engine.config
         self.max_batch = int(
@@ -462,7 +535,11 @@ class MicroBatcher(_BatcherBase):
             else:
                 arrays = _drain()
             t1 = time.perf_counter_ns()
-            results = self.engine.detok_rows(arrays, len(live))
+            words, lengths, scores, alphas = arrays
+            scores = self._apply_quality_skew(scores)
+            results = self.engine.detok_rows(
+                (words, lengths, scores, alphas), len(live)
+            )
             t2 = time.perf_counter_ns()
             # the aggregate span keeps its pre-split meaning (drain+detok)
             # so /stats latency percentiles stay comparable across runs
@@ -510,6 +587,9 @@ class MicroBatcher(_BatcherBase):
             r.result = result
             r.done.set()
             self._tel.count("serve/completed")
+        # quality observation AFTER completion: requesters never wait on
+        # signal extraction or exemplar I/O
+        self._observe_quality(live, words, lengths, scores, alphas, results)
 
     def _dispatch_group(self, group: List[Request], slot: str, inflight) -> None:
         try:
@@ -596,6 +676,8 @@ class ContinuousBatcher(_BatcherBase):
         on_wedge: Optional[Callable[[], None]] = None,
         wedge_timeout_ms: Optional[float] = None,
         weights: Optional[Dict[str, float]] = None,
+        quality=None,
+        exemplars=None,
     ) -> None:
         super().__init__(
             engine,
@@ -604,6 +686,8 @@ class ContinuousBatcher(_BatcherBase):
             on_wedge=on_wedge,
             wedge_timeout_ms=wedge_timeout_ms,
             weights=weights,
+            quality=quality,
+            exemplars=exemplars,
         )
         if pool is None:
             from .slot_pool import PagedSlotPool
@@ -830,7 +914,7 @@ class ContinuousBatcher(_BatcherBase):
     def _harvest(self, done: np.ndarray, pool=None) -> None:
         pool = pool if pool is not None else self.pool
         t0 = time.perf_counter_ns()
-        payloads, words, lengths, scores, steps = pool.harvest(done)
+        payloads, words, lengths, scores, steps, alphas = pool.harvest(done)
         t1 = time.perf_counter_ns()
         for i, r in enumerate(payloads):
             r.mark("drain", t0, t1 - t0)
@@ -845,14 +929,15 @@ class ContinuousBatcher(_BatcherBase):
             # raw per-request loop-iteration count (not ns): short
             # captions SHOW their early retirement here
             self._tel.record("serve/decode_steps", 0, int(steps[i]))
-        self._detok_q.put((payloads, words, lengths, scores, t1))
+        self._detok_q.put((payloads, words, lengths, scores, alphas, t1))
 
     def _detok_loop(self) -> None:
         while True:
             item = self._detok_q.get()
             if item is None:
                 return
-            payloads, words, lengths, scores, t1 = item
+            payloads, words, lengths, scores, alphas, t1 = item
+            scores = self._apply_quality_skew(scores)
             # harvest → dequeue is detok-THREAD queueing, not string work:
             # attribute it to its own span so serve/detok (and the
             # per-request detok phase) measures pure detokenize cost — a
@@ -879,6 +964,11 @@ class ContinuousBatcher(_BatcherBase):
                 r.result = result
                 r.done.set()
                 self._tel.count("serve/completed")
+            # after completion, on the detok thread — the step loop never
+            # pays for signal extraction or exemplar I/O
+            self._observe_quality(
+                payloads, words, lengths, scores, alphas, results
+            )
 
     def _maybe_rewarm(self) -> None:
         try:

@@ -344,7 +344,11 @@ class ServeEngine:
         beam_kwargs = dict(
             beam_size=config.beam_size,
             valid_size=len(self.vocabulary.words),
-            return_alphas=False,
+            # the quality plane reads coverage/entropy off the harvested
+            # alphas, so quality-on warms executables that carry them in
+            # the result pytree (drained with the batch — no extra sync);
+            # off keeps the pre-quality memory/transfer footprint
+            return_alphas=config.serve_quality == "on",
             # per-batch decode-step counts ride the result pytree and are
             # drained with it — the serve/decode_steps observability probe
             return_steps=True,
@@ -443,7 +447,8 @@ class ServeEngine:
 
     def drain_output(self, out, n: int) -> Tuple[np.ndarray, ...]:
         """Drain the device result for the ``n`` live rows: host arrays
-        (words, lengths, log_scores).  This is the serve path's one
+        (words, lengths, log_scores, alphas-or-None).  This is the serve
+        path's one
         host↔device sync — split from detokenization so the batcher can
         time (and the request tracer attribute) device wait separately
         from host string work."""
@@ -454,6 +459,10 @@ class ServeEngine:
         words = np.asarray(out.words)[:n]  # sync-ok: serve detok boundary — batch results drained once
         lengths = np.asarray(out.lengths)[:n]  # sync-ok: serve detok boundary
         scores = np.asarray(out.log_scores)[:n]  # sync-ok: serve detok boundary
+        alphas = None
+        if out.alphas is not None:
+            # part of the same batched result transfer (quality-on only)
+            alphas = np.asarray(out.alphas)[:n]  # sync-ok: serve detok boundary, rides the batch drain
         if out.steps_run is not None:
             # raw loop-iteration count (not ns); /stats reports raw
             # percentiles and the bench divides by request count
@@ -464,14 +473,16 @@ class ServeEngine:
             # path's fused window, reported on the same probe so both
             # modes' dispatch amortization reads off one /stats block
             self._tel.record("serve/steps_per_dispatch", 0, steps)
-        return words, lengths, scores
+        return words, lengths, scores, alphas
 
     def detok_rows(
         self, arrays: Tuple[np.ndarray, ...], n: int
     ) -> List[Dict[str, Any]]:
         """Detokenize every beam of ``n`` drained rows — pure host work on
-        numpy arrays, no device access."""
-        words, lengths, scores = arrays
+        numpy arrays, no device access.  ``arrays`` may carry a trailing
+        alphas element (quality-on drains); detok only needs the first
+        three."""
+        words, lengths, scores = arrays[:3]
         results = []
         for i in range(n):
             captions = []

@@ -213,6 +213,48 @@ def fleet_tenants_cost(
     return fleet
 
 
+def fleet_quality(
+    replicas: Dict[str, Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Fan the per-replica ``quality`` blocks (telemetry/quality.py
+    snapshots polled off each replica's /stats) into one fleet view.
+    Counts (requests, outliers) sum; drift takes the WORST replica —
+    PSI is a per-reference distance, so averaging replicas would let a
+    healthy majority mask one drifting model.  Pure dict arithmetic,
+    jax-free, like :func:`fleet_tenants_cost`."""
+    out: Dict[str, Any] = {}
+    requests = outliers = 0
+    psi_max = None
+    worst = ""
+    per_replica: Dict[str, Any] = {}
+    for name, snap in replicas.items():
+        block = snap.get("quality")
+        if not isinstance(block, dict):
+            continue
+        requests += int(block.get("requests", 0) or 0)
+        outliers += int(block.get("outliers", 0) or 0)
+        psi = block.get("psi_max")
+        if isinstance(psi, (int, float)) and not isinstance(psi, bool):
+            if psi_max is None or psi > psi_max:
+                psi_max, worst = float(psi), name  # sync-ok: host JSON scalar
+        per_replica[name] = {
+            "psi_max": psi,
+            "requests": block.get("requests", 0),
+            "outliers": block.get("outliers", 0),
+            "reference": block.get("reference") or None,
+        }
+    if not per_replica:
+        return out
+    out = {
+        "requests": requests,
+        "outliers": outliers,
+        "psi_max": round(psi_max, 6) if psi_max is not None else None,
+        "worst_replica": worst or None,
+        "replicas": per_replica,
+    }
+    return out
+
+
 def _percentiles_ms(tel, name: str) -> Optional[Dict[str, Any]]:
     """p50/p95/p99 (ms) of a router span; host telemetry ring only."""
     data = np.asarray(tel.durations_ns(name), np.float64)  # sync-ok: host telemetry ring
@@ -531,6 +573,9 @@ class Router:
         cap = stats.get("capacity")
         if isinstance(cap, dict) and "headroom_pct" in cap:
             snap["capacity_headroom_pct"] = cap["headroom_pct"]
+        quality = stats.get("quality")
+        if isinstance(quality, dict):
+            snap["quality"] = quality
 
     def _advance_drains(self) -> None:
         """Drain progression: a locally spawned replica is drained when
@@ -1108,6 +1153,11 @@ class Router:
                 if (fleet_cost := fleet_tenants_cost(view["replicas"]))
                 else {}
             ),
+            **(
+                {"quality": fq}
+                if (fq := fleet_quality(view["replicas"]))
+                else {}
+            ),
         }
 
     def metrics_text(self) -> str:
@@ -1131,6 +1181,13 @@ class Router:
         ]
         if headrooms:
             self._tel.gauge("route/fleet_headroom_pct", min(headrooms))
+        # fleet quality: worst-replica drift + summed outliers, so the
+        # router scrape pages on one drifting model in a healthy fleet
+        fq = fleet_quality(view["replicas"])
+        if fq:
+            if fq.get("psi_max") is not None:
+                self._tel.gauge("route/fleet_quality_psi_max", fq["psi_max"])
+            self._tel.gauge("route/fleet_quality_outliers", fq["outliers"])
         return promtext.render(self._tel)
 
     # -- lifecycle ---------------------------------------------------------

@@ -29,6 +29,10 @@ Endpoints:
 * ``POST /profile?duration_ms=N`` — start a bounded live ``jax.profiler``
   capture into ``<telemetry_dir>/profiles/<ts>/``; 409 while another
   capture runs, duration clamped to the hard cap (telemetry.profwin).
+* ``GET /quality_reference`` — export the frozen quality-reference
+  distributions (telemetry.quality) for ``--quality_reference`` on
+  another replica; 404 with ``--serve_quality off``, 409 before one
+  froze.
 
 Every reply — including 400/429/503/504 sheds and 404s — echoes
 ``X-Request-Id`` (inbound value sanitized, or minted), and each
@@ -64,8 +68,10 @@ from ..resilience.preempt import GracefulShutdown
 from ..telemetry import promtext, tracectx
 from ..telemetry.capacity import CapacityModel, EncodeCacheSketch
 from ..telemetry.heartbeat import Heartbeat
+from ..telemetry.exemplar import ExemplarRecorder
 from ..telemetry.metering import MeteringLedger
 from ..telemetry.profwin import ProfileLatch
+from ..telemetry.quality import QualityMonitor, QualityReference
 from ..telemetry.slo import SLOEngine, objectives_from_config
 from ..utils.summary import crc32c
 from .batcher import ContinuousBatcher, MicroBatcher, Rejected
@@ -192,6 +198,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(
                 200, app.metrics_text().encode(), promtext.CONTENT_TYPE, rid
             )
+        elif route == "/quality_reference":
+            payload, status = app.quality_reference()
+            self._reply(status, payload, rid)
         else:
             self._reply(404, {"error": f"no route {self.path}"}, rid)
 
@@ -291,6 +300,44 @@ class CaptionServer:
         self.tenants = TenantRegistry.parse(config.tenants)
         self._load_residents()
         weights = self.tenants.weights() if self.tenants.multi else None
+        tdir = config.telemetry_dir or os.path.join(
+            config.summary_dir, "telemetry"
+        )
+        # caption-quality plane (telemetry/quality.py): streaming signal
+        # sketches + PSI drift vs a frozen reference, and the exemplar
+        # flight recorder for outlier requests.  Off (the default) means
+        # no monitor, no recorder, no alphas in the warmed executables —
+        # bit-identical to the pre-quality serving path (pinned by
+        # tests/test_quality.py).
+        self.quality: Optional[QualityMonitor] = None
+        self.exemplars: Optional[ExemplarRecorder] = None
+        if config.serve_quality == "on":
+            reference = None
+            if config.serve_quality_reference:
+                reference = QualityReference.load(
+                    config.serve_quality_reference
+                )
+            self.quality = QualityMonitor(
+                window=config.serve_quality_window,
+                reference=reference,
+                margin_min=config.serve_quality_margin_min,
+                unk_max=config.serve_quality_unk_max,
+                tel=self._tel,
+            )
+            self.exemplars = ExemplarRecorder(
+                config.serve_quality_exemplar_dir
+                or os.path.join(tdir, "exemplars"),
+                budget_mb=config.serve_quality_exemplar_mb,
+            )
+            # replay context: scripts/replay_exemplar.py boots from THIS
+            # meta, never from guessed flags
+            self.exemplars.write_meta(
+                {
+                    "config": config.to_dict(),
+                    "model_step": engine.step,
+                    "vocab_crc32c": f"{crc32c(chr(10).join(engine.vocabulary.words).encode('utf-8')):08x}",
+                }
+            )
         # admission knobs come from THIS server's config (which may be a
         # replace() of the engine's — e.g. a tighter queue for the same
         # warmed engine), not the engine's defaults
@@ -310,6 +357,8 @@ class CaptionServer:
                 on_wedge=self._on_wedge,
                 wedge_timeout_ms=config.serve_wedge_timeout_ms,
                 weights=weights,
+                quality=self.quality,
+                exemplars=self.exemplars,
             )
         else:
             self.batcher = MicroBatcher(
@@ -321,6 +370,8 @@ class CaptionServer:
                 on_wedge=self._on_wedge,
                 wedge_timeout_ms=config.serve_wedge_timeout_ms,
                 weights=weights,
+                quality=self.quality,
+                exemplars=self.exemplars,
             )
         self._host = host if host is not None else config.serve_host
         self._requested_port = (
@@ -629,9 +680,15 @@ class CaptionServer:
         try:
             req = self.batcher.submit(
                 image, deadline_unix=deadline_unix, trace=trace, slot=slot,
-                tenant=spec.name,
+                tenant=spec.name, raw=body,
             )
         except Rejected as e:
+            # shed exemplar: a rate-limited sample of refused requests
+            # lands in the flight recorder with its image bytes, so a
+            # shed storm leaves replayable evidence, not just a counter
+            self._record_terminal_exemplar(
+                trace, e.status, "shed", tname, body
+            )
             payload = {"error": e.reason}
             if e.status in (429, 503):
                 # Retry-After computed from the SHEDDING SCOPE: a
@@ -651,6 +708,7 @@ class CaptionServer:
         )
         if not req.done.wait(timeout=wait_s):
             self._tel.count("serve/timeouts")
+            self._record_terminal_exemplar(trace, 504, "timeout", tname, body)
             # the request may still be riding decode windows; charge
             # whatever device time it accrued so far — abandoned work is
             # still the tenant's cost
@@ -703,6 +761,30 @@ class CaptionServer:
             cost=req.cost,
         )
 
+    def _record_terminal_exemplar(
+        self,
+        trace: "tracectx.RequestTrace",
+        status: int,
+        reason: str,
+        tenant: Optional[str],
+        body: bytes,
+    ) -> None:
+        """Shed/timeout outliers never reach the detok boundary, so the
+        HTTP path records them directly (rate-limited by the recorder;
+        failures swallowed — observability never fails a request)."""
+        if self.exemplars is None:
+            return
+        try:
+            self.exemplars.record(
+                reasons=[reason],
+                request_id=trace.trace_id,
+                tenant=tenant or "default",
+                status=status,
+                image_bytes=body,
+            )
+        except Exception:
+            self._tel.count("serve/quality_errors")
+
     def _retry_hint_ms(self) -> int:
         """Retry-After hint for 429 sheds: about one service period — the
         observed p50 end-to-end latency when we have one, else twice the
@@ -733,7 +815,13 @@ class CaptionServer:
         # would spread tenant A's overload onto tenant B, the exact
         # failure the isolation plane exists to prevent.  The lanes stay
         # visible in slo_burning / /metrics for per-tenant alerting.
-        service_burning = [n for n in burning if not n.startswith("tenant_")]
+        # quality_* lanes are diagnostic the same way: caption drift is
+        # a MODEL problem — rolling traffic to a replica serving the
+        # same checkpoint fixes nothing, so /healthz stays ok while the
+        # drift lanes burn (pinned by the quality_drift chaos scenario).
+        service_burning = [
+            n for n in burning if not n.startswith(("tenant_", "quality_"))
+        ]
         degraded = self._degraded or bool(service_burning)
         payload.update(
             {
@@ -912,6 +1000,15 @@ class CaptionServer:
                 for name, value in self._tel.gauges().items()
                 if name.startswith("capacity/")
             }
+        if self.quality is not None:
+            # per-request quality signals + drift vs the frozen
+            # reference (telemetry/quality.py); the router fans this
+            # block into the fleet view like tenants_cost
+            self.quality.maybe_publish(force=True)
+            qblock = self.quality.snapshot()
+            if self.exemplars is not None:
+                qblock["exemplars"] = self.exemplars.stats()
+            out["quality"] = qblock
         return out
 
     def _tenant_block(self, counters: Dict[str, int]) -> Dict[str, Any]:
@@ -996,8 +1093,31 @@ class CaptionServer:
             # ceiling, lane fill, would-hit ratio) — rate-limited, so an
             # aggressive scraper costs one clock read per scrape
             self.capacity.maybe_update()
+        if self.quality is not None:
+            # scrape-time refresh of the quality/* gauges (per-signal
+            # PSI, psi_max, unk rate) so the drift SLO lanes and the
+            # Prometheus series never lag the rate limiter
+            self.quality.maybe_publish(force=True)
         extra = self.heartbeat.payload() if self.heartbeat else None
         return promtext.render(self._tel, extra=extra, histograms=_HISTOGRAMS)
+
+    def quality_reference(self) -> Tuple[Dict[str, Any], int]:
+        """GET /quality_reference: export the frozen reference so another
+        replica (or the next deploy) can pin drift scoring to THIS
+        steady state via ``--quality_reference``.  404 with the quality
+        plane off, 409 before warmup traffic froze a reference."""
+        if self.quality is None:
+            return {"error": "quality plane off; boot with --serve_quality on"}, 404
+        payload = self.quality.reference_payload()
+        if payload is None:
+            return {
+                "error": (
+                    "no reference frozen yet; serve at least "
+                    f"{self.quality.window} requests or load one with "
+                    "--quality_reference"
+                )
+            }, 409
+        return payload, 200
 
     def start_profile(self, duration_ms=None) -> Tuple[bool, str]:
         """Begin a bounded live profiler capture (``POST /profile``);

@@ -135,8 +135,13 @@ class PagedSlotPool:
         compiles0 = self._tel.counters().get("jax/compiles", 0)
         t0 = time.perf_counter()
 
+        # quality-on carries per-slot alphas through the pool so the
+        # detok boundary can read coverage/entropy off the same drain;
+        # off keeps the pre-quality carry footprint bit-for-bit
+        want_alphas = config.serve_quality == "on"
         pool_statics = dict(
-            config=config, slots=S, beam_size=K, max_len=self.max_len
+            config=config, slots=S, beam_size=K, max_len=self.max_len,
+            return_alphas=want_alphas,
         )
         reset_jit = jax.jit(
             init_slot_pool,
@@ -184,7 +189,7 @@ class PagedSlotPool:
         )
         self._harvest_exec = (
             jax.jit(harvest_slots, static_argnames=("return_alphas",))
-            .lower(carry_sd)
+            .lower(carry_sd, return_alphas=want_alphas)
             .compile()
         )
         self._retire_exec = (
@@ -372,8 +377,10 @@ class PagedSlotPool:
     def harvest(self, done: np.ndarray):
         """Drain and free the slots flagged in ``done`` (host bool [S]).
 
-        Returns ``(payloads, words, lengths, scores, steps)`` with one
-        row per harvested slot, in slot order.  Whole-array transfers
+        Returns ``(payloads, words, lengths, scores, steps, alphas)``
+        with one row per harvested slot, in slot order (``alphas`` is
+        None unless the pool was warmed quality-on).  Whole-array
+        transfers
         sliced on the HOST — a device-side gather at a varying row set
         would compile per distinct pattern (same rationale as
         ``ServeEngine.drain_output``)."""
@@ -385,6 +392,10 @@ class PagedSlotPool:
         lengths = np.asarray(out.lengths)  # sync-ok: continuous detok boundary
         scores = np.asarray(out.log_scores)  # sync-ok: continuous detok boundary
         steps = np.asarray(out.steps_run)  # sync-ok: continuous detok boundary
+        alphas = None
+        if out.alphas is not None:
+            # same drain, one more leaf of the harvested pytree
+            alphas = np.asarray(out.alphas)  # sync-ok: continuous detok boundary, rides the harvest drain
         retire = np.zeros((self.slots,), np.bool_)
         payloads = []
         for s in ids:
@@ -396,4 +407,7 @@ class PagedSlotPool:
             self._carry, jax.device_put(retire)
         )
         self._tel.gauge(self._occ_gauge, self.occupancy())
-        return payloads, words[ids], lengths[ids], scores[ids], steps[ids]
+        return (
+            payloads, words[ids], lengths[ids], scores[ids], steps[ids],
+            None if alphas is None else alphas[ids],
+        )

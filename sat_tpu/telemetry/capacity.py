@@ -22,7 +22,9 @@ forward-looking questions no raw counter does:
   on.
 
 Everything here is host-side arithmetic over already-collected numbers:
-``maybe_update`` is rate-limited (once per ``interval_s``) and called
+``maybe_update`` is rate-limited (once per ``interval_s``, except the
+very first publish, which always goes through so early scrapes never
+see an empty capacity block) and called
 from boundaries that already run per request or per scrape — zero
 device syncs, zero steady-state recompiles.
 
@@ -110,6 +112,7 @@ class CapacityModel(object):
             0.0,
         )
         self._ceiling = 0.0  # last known, held across idle windows
+        self._published = False  # first publish bypasses the rate limit
 
     def _cumulative(self) -> Dict[str, float]:
         snap = self._ledger.snapshot() if self._ledger is not None else {}
@@ -136,7 +139,10 @@ class CapacityModel(object):
         now = self._clock()
         with self._lock:
             window_s = now - self._t_last
-            if not force and window_s < self._interval:
+            if not force and self._published and window_s < self._interval:
+                # Rate-limited — except the very first publish, which must
+                # not race the interval: a scrape that lands before any
+                # update would otherwise see an empty capacity block.
                 return
             self._t_last = now
             cur = self._cumulative()
@@ -151,6 +157,7 @@ class CapacityModel(object):
         if d_req > 0 and d_occ_s > 0:
             self._ceiling = self._slots * d_req / d_occ_s
         tel = self._tel
+        self._published = True
         tel.gauge("capacity/slot_busy_ratio", round(busy, 4))
         tel.gauge("capacity/headroom_pct", round(100.0 * (1.0 - busy), 2))
         tel.gauge("capacity/ceiling_captions_per_s", round(self._ceiling, 3))

@@ -205,6 +205,17 @@ class Heartbeat:
         }
         if fleet:
             payload["fleet"] = fleet
+        # caption-quality plane (telemetry.quality): per-signal PSI vs
+        # the frozen reference, unk-rate, outlier count — the heartbeat
+        # is where a watcher sees the model drift before anyone reads a
+        # caption
+        quality = {
+            k[len("quality/"):]: v
+            for k, v in gauges.items()
+            if k.startswith("quality/")
+        }
+        if quality:
+            payload["quality"] = quality
         if self._sampler is not None:
             try:
                 payload.update(self._sampler() or {})

@@ -373,6 +373,28 @@ def objectives_from_config(config, phase: str, tenants=()) -> List[Objective]:
                     source="capacity/headroom_pct",
                 )
             )
+        if config.slo_quality_psi > 0:
+            # quality plane (telemetry/quality.py): burn when the worst
+            # per-signal PSI vs the frozen reference stays at/above the
+            # ceiling — diagnostic like the tenant lanes (healthz stays
+            # "ok"; drift is a model problem, routing away fixes nothing)
+            out.append(
+                Objective(
+                    name="quality_drift",
+                    kind="gauge_ceiling",
+                    target=config.slo_quality_psi,
+                    source="quality/psi_max",
+                )
+            )
+        if config.slo_quality_unk > 0:
+            out.append(
+                Objective(
+                    name="quality_unk",
+                    kind="gauge_ceiling",
+                    target=config.slo_quality_unk,
+                    source="quality/unk_rate",
+                )
+            )
         for name, p99_ms, error_ratio in tenants:
             if p99_ms > 0:
                 out.append(

@@ -1528,6 +1528,209 @@ def tenant_flood_isolation(ctx: Ctx):
              "compile_delta")}
 
 
+_QUALITY_DRIFT_CHILD = r'''
+import json, os, sys, time, urllib.error, urllib.request
+
+import cv2
+import jax
+import numpy as np
+
+from sat_tpu import runtime, telemetry
+from sat_tpu.config import Config
+from sat_tpu.data.vocabulary import Vocabulary
+from sat_tpu.resilience import lineage
+from sat_tpu.serve.engine import ServeEngine, load_serving_state
+from sat_tpu.serve.server import CaptionServer
+from sat_tpu.telemetry.exemplar import load_image, read_exemplars
+from sat_tpu.train.checkpoint import save_checkpoint
+from sat_tpu.train.step import create_train_state
+
+workdir = sys.argv[1]
+vocab_file = os.path.join(workdir, "vocabulary.csv")
+vocabulary = Vocabulary(size=30)
+vocabulary.build(["a man riding a horse.", "a cat on a table."])
+vocabulary.save(vocab_file)
+exdir = os.path.join(workdir, "exemplars")
+
+config = Config(
+    phase="serve", image_size=32, dim_embedding=16, num_lstm_units=16,
+    dim_initialize_layer=16, dim_attend_layer=16, dim_decode_layer=32,
+    compute_dtype="float32", vocabulary_size=vocabulary.size,
+    vocabulary_file=vocab_file, beam_size=2,
+    save_dir=os.path.join(workdir, "models"),
+    summary_dir=os.path.join(workdir, "summary"),
+    serve_buckets=(1, 4), serve_max_batch=4,
+    serve_quality="on", serve_quality_window=24,
+    serve_quality_exemplar_dir=exdir,
+    slo_quality_psi=0.2,
+    slo_window_fast_s=1.5, slo_window_slow_s=3.0,
+    heartbeat_interval=0.0,
+)
+os.makedirs(config.save_dir, exist_ok=True)
+tel = telemetry.enable(capacity=16384)
+runtime._install_compile_listener()
+state = create_train_state(jax.random.PRNGKey(0), config)
+# bias the eos logit so the random model seals captions with "." — the
+# eos_trunc outlier reason must stay quiet in the control phase
+eos = vocabulary.word2idx["."]
+params = jax.tree_util.tree_map(lambda x: x, state.params)
+b = params["decoder"]["decode"]["fc_2"]["bias"]
+params["decoder"]["decode"]["fc_2"]["bias"] = b.at[eos].add(4.0)
+state = state._replace(params=params)
+save_checkpoint(state, config)
+lineage.mark_last_good(config.save_dir, int(np.asarray(state.step)))
+state, _ = load_serving_state(config)
+engine = ServeEngine(config, state, vocabulary, tel=tel)
+engine.warmup()
+server = CaptionServer(config, engine, port=0).start()
+port = server.port
+
+img = np.random.default_rng(0).integers(0, 255, (32, 32, 3), dtype=np.uint8)
+ok, buf = cv2.imencode(".jpg", img)
+jpeg = bytes(buf)
+
+
+def post(timeout=90.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/caption", data=jpeg, method="POST",
+        headers={"Content-Type": "image/jpeg"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def get(route):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{route}", timeout=10) as r:
+        return r.status, r.read()
+
+
+# phase A (control arm): steady traffic on one repeated image freezes
+# the reference and must capture ZERO exemplars — unremarkable traffic
+# is not an outlier
+for _ in range(32):
+    status, body = post()
+    assert status == 200, (status, body)
+stats_control = json.loads(get("/stats")[1])
+qc = stats_control.get("quality") or {}
+control = {
+    "requests": qc.get("requests"),
+    "reference": qc.get("reference"),
+    "psi_max": qc.get("psi_max"),
+    "exemplars_recorded": (qc.get("exemplars") or {}).get("recorded"),
+    "burning": tel.gauges().get("slo/quality_drift_burning", 0),
+}
+compiles0 = tel.counters().get("jax/compiles", 0)
+
+# phase B: arm the score-space fault (read per-call, so flipping the
+# env mid-run works) and keep serving the SAME image — captions must
+# not change, but margins/norm-logprob shift hard off the reference
+os.environ["SAT_FI_QUALITY_SKEW"] = "2000"  # 20.0 nats off the top beam
+for _ in range(40):
+    status, body = post()
+    assert status == 200, (status, body)
+
+drift_burning = 0
+deadline = time.monotonic() + 25.0
+while time.monotonic() < deadline and not drift_burning:
+    if tel.gauges().get("slo/quality_drift_burning") == 1:
+        drift_burning = 1
+    else:
+        time.sleep(0.25)
+# health probed AT the burn moment: drift is diagnostic — a model
+# problem the router cannot route away from — so /healthz stays ok
+health_status = json.loads(get("/healthz")[1]).get("status")
+stats = json.loads(get("/stats")[1])
+q = stats.get("quality") or {}
+metrics_raw = get("/metrics")[1]
+
+# replay one captured exemplar through the engine directly (no batcher,
+# no skew in that path): the caption must come back bitwise identical
+rows, torn = read_exemplars(exdir)
+replayable = [r for r in rows if r.get("image")]
+replay = {"rows": len(rows), "torn": torn, "replayable": len(replayable)}
+if replayable:
+    row = replayable[-1]
+    data = load_image(exdir, row)
+    batch, _b = engine.pad_batch([engine.preprocess(data)])
+    out = engine.dispatch(batch)
+    res = engine.decode_output(out, 1)
+    replay["captured"] = row.get("caption")
+    replay["replayed"] = res[0]["captions"][0]["caption"]
+    replay["bitwise"] = replay["captured"] == replay["replayed"]
+    replay["reasons"] = row.get("reasons")
+
+result = {
+    "control": control,
+    "drift_burning": drift_burning,
+    "health_status": health_status,
+    "psi_max": q.get("psi_max"),
+    "outliers": q.get("outliers"),
+    "exemplars_recorded": (q.get("exemplars") or {}).get("recorded"),
+    "compile_delta": tel.counters().get("jax/compiles", 0) - compiles0,
+    "metrics_has_quality": b"quality/psi_max" in metrics_raw,
+    "replay": replay,
+}
+server.shutdown()
+print(json.dumps(result))
+'''
+
+
+@scenario
+def quality_drift(ctx: Ctx):
+    """ISSUE 19 acceptance: a score-space fault (SAT_FI_QUALITY_SKEW)
+    shifts beam scores under load on a quality-on server.  The control
+    phase (same traffic, no skew) freezes the reference and captures
+    ZERO exemplars; under skew the ``quality_drift`` SLO lane burns
+    while /healthz stays ok (drift is diagnostic, not routable), the
+    flight recorder captures drift exemplars, one replays bitwise
+    through a skew-free engine, and the whole episode costs zero
+    steady-state recompiles."""
+    workdir = os.path.join(ctx.root, "quality_drift")
+    os.makedirs(workdir, exist_ok=True)
+    proc = subprocess.run(
+        [sys.executable, "-c", _QUALITY_DRIFT_CHILD, workdir],
+        capture_output=True, text=True, cwd=REPO,
+        env=_child_env(), timeout=_TIMEOUT,
+    )
+    check(proc.returncode == 0,
+          f"quality drift child rc {proc.returncode}\n"
+          f"{proc.stdout}\n{proc.stderr}")
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    control = result["control"]
+    check(control["reference"] == "warmup",
+          f"reference never froze from warmup traffic: {control}")
+    check(control["exemplars_recorded"] == 0,
+          f"control arm captured exemplars: {control}")
+    check(control["burning"] == 0,
+          f"drift lane burned before any fault: {control}")
+    check(result["drift_burning"] == 1,
+          f"quality_drift lane never burned under skew "
+          f"(psi_max {result['psi_max']})")
+    check(result["health_status"] == "ok",
+          f"a quality-lane burn degraded fleet-facing health: "
+          f"{result['health_status']!r}")
+    check((result["exemplars_recorded"] or 0) >= 1,
+          f"no exemplars captured under drift: {result}")
+    check(result["compile_delta"] == 0,
+          f"quality skew recompiled steady state: "
+          f"{result['compile_delta']}")
+    check(result["metrics_has_quality"],
+          "quality/* series missing from /metrics")
+    replay = result["replay"]
+    check(replay.get("bitwise") is True,
+          f"exemplar did not replay bitwise: {replay}")
+    check(any(str(r).startswith("drift_") for r in
+              (replay.get("reasons") or [])),
+          f"captured exemplar carries no drift reason: {replay}")
+    return {
+        "psi_max": result["psi_max"],
+        "outliers": result["outliers"],
+        "exemplars_recorded": result["exemplars_recorded"],
+        "replayed_bitwise": replay.get("bitwise"),
+        "compile_delta": result["compile_delta"],
+    }
+
+
 # -- orchestration ----------------------------------------------------------
 
 

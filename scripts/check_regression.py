@@ -127,6 +127,10 @@ DEFAULT_MARGINS = {
     # would-hit probe is a seeded-Zipf hit fraction, nearly deterministic
     "metering_overhead_pct": 25.0,
     "encode_cache_would_hit_ratio": 10.0,
+    # quality-plane row (docs/OBSERVABILITY.md "Caption quality"): the
+    # same noise-floored microbench-over-p50 shape as metering_overhead
+    # (bench_quality exit-gates the raw value at 0.5% separately)
+    "quality_overhead_pct": 25.0,
 }
 FALLBACK_MARGIN = 5.0
 

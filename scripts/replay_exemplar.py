@@ -1,0 +1,243 @@
+"""Replay a captured quality exemplar through a freshly booted engine.
+
+The exemplar flight recorder (sat_tpu/telemetry/exemplar.py) stores, for
+each outlier request, the raw image bytes plus the caption the serving
+stack produced and a ``meta.json`` describing exactly which model
+produced it (full config snapshot, checkpoint step, vocabulary
+fingerprint).  This script is the other half of that contract: boot the
+SAME engine headless — no HTTP, no batcher, just the AOT encode+beam
+pair — push the stored bytes back through ``preprocess → dispatch →
+decode``, and assert the caption comes back **bitwise identical**.
+
+That assertion is the debugging fork for every captured outlier:
+
+* replay matches → the model really says that about this image; the
+  outlier is a model/data problem (follow the drift runbook in
+  docs/OBSERVABILITY.md).
+* replay differs → serving infrastructure produced a caption the model
+  alone does not reproduce — a nondeterminism bug worth paging on.
+
+Scores are compared informationally, not asserted: an exemplar captured
+under the SAT_FI_QUALITY_SKEW fault point (or any score-space fault) has
+shifted log-probs by design while its token sequence — and therefore the
+caption text — must still replay exactly.
+
+``--diff A B`` mode replays the exemplar through two checkpoints instead
+and reports their caption divergence (telemetry.quality's token-Jaccard,
+the same score the lifecycle canary gates on) — "did the new model stop
+saying this" as a one-command answer.
+
+Usage:
+  python scripts/replay_exemplar.py --dir DIR                # newest exemplar
+  python scripts/replay_exemplar.py --dir DIR --index 3      # specific row
+  python scripts/replay_exemplar.py --dir DIR --request-id R # by trace id
+  python scripts/replay_exemplar.py --dir DIR --all          # every replayable row
+  python scripts/replay_exemplar.py --dir DIR --diff OLD.npz NEW.npz
+
+Exit codes: 0 replayed bitwise (or --diff ran), 1 caption mismatch,
+2 usage / missing data (no meta, image evicted, checkpoint unloadable).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(f"[replay_exemplar] {msg}", file=sys.stderr, flush=True)
+
+
+def _boot_engine(config, model_file: Optional[str]):
+    """Config snapshot → warmed ServeEngine, exactly the server's boot
+    path (lineage-verified load unless --model pins a file)."""
+    from sat_tpu.data.vocabulary import Vocabulary
+    from sat_tpu.serve.engine import ServeEngine, load_serving_state
+
+    vocabulary = Vocabulary(config.vocabulary_size, config.vocabulary_file)
+    state, source = load_serving_state(config, model_file=model_file)
+    engine = ServeEngine(config, state, vocabulary)
+    log(f"params from {source} (step {engine.step}); warming bucket ladder")
+    engine.warmup()
+    return engine
+
+
+def _caption_once(engine, image_bytes: bytes) -> Dict:
+    """One headless request: bytes → {caption, beams, alphas_digest}."""
+    from sat_tpu.telemetry.exemplar import alphas_digest
+
+    row = engine.preprocess(image_bytes)
+    batch, _bucket = engine.pad_batch([row])
+    out = engine.dispatch(batch)
+    words, lengths, scores, alphas = engine.drain_output(out, 1)
+    results = engine.detok_rows((words, lengths, scores), 1)
+    captions = results[0]["captions"]
+    return {
+        "caption": captions[0]["caption"],
+        "beams": captions,
+        "alphas_digest": (
+            alphas_digest(alphas[0]) if alphas is not None else None
+        ),
+    }
+
+
+def _pick_rows(rows: List[Dict], args) -> List[Dict]:
+    replayable = [r for r in rows if r.get("image")]
+    if args.request_id:
+        picked = [
+            r for r in replayable if r.get("request_id") == args.request_id
+        ]
+        if not picked:
+            log(f"no replayable exemplar with request_id={args.request_id!r}")
+            sys.exit(2)
+        return picked
+    if args.index is not None:
+        if not (0 <= args.index < len(rows)):
+            log(f"--index {args.index} out of range (have {len(rows)} rows)")
+            sys.exit(2)
+        row = rows[args.index]
+        if not row.get("image"):
+            log(
+                f"exemplar {args.index} has no stored image "
+                f"(over the size cap or evicted; image_bytes="
+                f"{row.get('image_bytes')})"
+            )
+            sys.exit(2)
+        return [row]
+    if args.all:
+        return replayable
+    if not replayable:
+        log("no replayable exemplars (no rows with a stored image)")
+        sys.exit(2)
+    return [replayable[-1]]  # newest: rows arrive sorted by t_unix
+
+
+def _replay_one(engine, dir: str, row: Dict) -> bool:
+    """Replay one exemplar; True when the caption matched bitwise."""
+    from sat_tpu.telemetry.exemplar import load_image
+
+    image = load_image(dir, row)
+    if image is None:
+        log(f"image {row.get('image')} missing (evicted?) — skipping")
+        return False
+    got = _caption_once(engine, image)
+    want = row.get("caption", "")
+    rid = row.get("request_id", "") or "<no id>"
+    match = got["caption"] == want
+    verdict = "BITWISE MATCH" if match else "MISMATCH"
+    print(
+        json.dumps(
+            {
+                "request_id": rid,
+                "reasons": row.get("reasons", []),
+                "verdict": verdict,
+                "captured": want,
+                "replayed": got["caption"],
+                # informational: scores may legitimately differ (score-space
+                # fault injection at capture time); alphas digests may differ
+                # across serve modes (fused-window vs monolithic decode)
+                "alphas_digest_captured": row.get("alphas_digest"),
+                "alphas_digest_replayed": got["alphas_digest"],
+            },
+            sort_keys=True,
+        ),
+        flush=True,
+    )
+    return match
+
+
+def _run_diff(config, rows: List[Dict], dir: str, files: List[str]) -> int:
+    from sat_tpu.telemetry.exemplar import load_image
+    from sat_tpu.telemetry.quality import caption_divergence
+
+    engines = [_boot_engine(config, f) for f in files]
+    for row in rows:
+        image = load_image(dir, row)
+        if image is None:
+            log(f"image {row.get('image')} missing — skipping")
+            continue
+        a = _caption_once(engines[0], image)["caption"]
+        b = _caption_once(engines[1], image)["caption"]
+        print(
+            json.dumps(
+                {
+                    "request_id": row.get("request_id", ""),
+                    "captured": row.get("caption", ""),
+                    "old": a,
+                    "new": b,
+                    "divergence": round(caption_divergence(a, b), 4),
+                },
+                sort_keys=True,
+            ),
+            flush=True,
+        )
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="Replay captured quality exemplars bitwise"
+    )
+    parser.add_argument("--dir", required=True, help="exemplar directory")
+    parser.add_argument("--model", default=None, help="override checkpoint file")
+    parser.add_argument("--index", type=int, default=None)
+    parser.add_argument("--request-id", default=None)
+    parser.add_argument("--all", action="store_true")
+    parser.add_argument(
+        "--diff",
+        nargs=2,
+        metavar=("OLD", "NEW"),
+        default=None,
+        help="replay through two checkpoints and report caption divergence",
+    )
+    args = parser.parse_args()
+
+    from sat_tpu.config import Config
+    from sat_tpu.telemetry.exemplar import read_exemplars, read_meta
+    from sat_tpu.utils.summary import crc32c
+
+    meta = read_meta(args.dir)
+    if not meta or "config" not in meta:
+        log(f"no usable meta.json in {args.dir} — cannot rebuild the engine")
+        return 2
+    config = Config.from_dict(meta["config"])
+    rows, torn = read_exemplars(args.dir)
+    if torn:
+        log(f"skipped {torn} torn exemplar line(s)")
+    if not rows:
+        log("no exemplars recorded")
+        return 2
+    picked = _pick_rows(rows, args)
+
+    if args.diff:
+        return _run_diff(config, picked, args.dir, list(args.diff))
+
+    engine = _boot_engine(config, args.model)
+    want_crc = meta.get("vocab_crc32c")
+    have_crc = "%08x" % crc32c(
+        "\n".join(engine.vocabulary.words).encode("utf-8")
+    )
+    if want_crc and want_crc != have_crc:
+        log(
+            f"vocabulary fingerprint mismatch (meta {want_crc} vs loaded "
+            f"{have_crc}) — captions cannot replay bitwise"
+        )
+        return 2
+    if meta.get("model_step") is not None and engine.step != meta["model_step"]:
+        log(
+            f"WARNING: replaying against step {engine.step}, exemplars were "
+            f"captured at step {meta['model_step']} (pass --model to pin)"
+        )
+    results = [_replay_one(engine, args.dir, row) for row in picked]
+    ok = sum(results)
+    log(f"{ok}/{len(results)} exemplar(s) replayed bitwise")
+    return 0 if ok == len(results) and results else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,7 +25,7 @@ GAVE_UP=""
 # RETRY_STAGES / RETRY_STAGE_CMD / RETRY_PROBE_CMD exist so the
 # give-up/artifact bookkeeping is testable without a device
 # (tests/test_bench.py); production runs never set them.
-ORDER=${RETRY_STAGES:-"bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 bench_ce_bf16 bench_eval_ab fused_decode bench_quant fleet_serve bench_bulk lifecycle_serve tenant_serve metering_serve pallas pallas_serve profile bench_early_exit"}
+ORDER=${RETRY_STAGES:-"bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 bench_ce_bf16 bench_eval_ab fused_decode bench_quant fleet_serve bench_bulk lifecycle_serve tenant_serve metering_serve quality_serve pallas pallas_serve profile bench_early_exit"}
 
 stage_cmd() {
   if [ -n "${RETRY_STAGE_CMD:-}" ]; then echo "$RETRY_STAGE_CMD"; return; fi
@@ -57,6 +57,9 @@ stage_cmd() {
     # charge-path microbench + unique/Zipf probe arms: attribution
     # overhead gate, accounting identity, would-be encode-cache ratio
     metering_serve)       echo "timeout 900 python scripts/bench_serve.py --metering" ;;
+    # quality-on live arm + signal/sketch microbench: drift-plane
+    # overhead gate (0.5% of serve p50), zero steady-state recompiles
+    quality_serve)        echo "timeout 900 python scripts/bench_quality.py" ;;
     # batch sweep (4 sizes x up-to-4 loop compiles each) needs more than
     # the single-B budget
     pallas)               echo "timeout 1800 python scripts/bench_pallas.py" ;;

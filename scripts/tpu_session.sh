@@ -175,6 +175,19 @@ if [ "$rc" -ne 0 ] || [ ! -s "$OUT/metering_serve.json" ]; then
   FAILED="$FAILED metering_serve"
 fi
 
+echo "=== stage 1l: caption-quality plane (drift overhead gate) ==="
+# quality-on live arm (zero-recompile assert, frozen reference) plus the
+# signal-extraction+sketch microbench priced against the live p50; exits
+# nonzero on overhead over the 0.5% gate, any steady-state recompile, or
+# a dead quality block
+timeout 900 python scripts/bench_quality.py \
+  2>"$OUT/quality_serve.log" | tee "$OUT/quality_serve.json"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/quality_serve.json" ]; then
+  echo "STAGE FAILED: quality_serve (rc=$rc) — see $OUT/quality_serve.log"
+  FAILED="$FAILED quality_serve"
+fi
+
 echo "=== stage 2: pallas attention measurement ==="
 timeout 1800 python scripts/bench_pallas.py 2>&1 | tee "$OUT/pallas.txt"
 rc=${PIPESTATUS[0]}

@@ -485,7 +485,7 @@ def test_slot_pool_bookkeeping_and_zero_recompile_reuse(served):
     for _ in range(engine.config.max_caption_length):
         done = np.asarray(pool.step())  # sync-ok: test drain
         if done.any():
-            payloads, words, lengths, scores, steps = pool.harvest(done)
+            payloads, words, lengths, scores, steps, _alphas = pool.harvest(done)
             assert words.shape[0] == len(payloads)
             assert steps.shape == (len(payloads),)
     assert pool.occupancy() == 0 and pool.free_count() == 4

@@ -320,8 +320,11 @@ def _sync_lint_targets():
     # fleet plane and black box, which tick at the train-loop log
     # boundary; the rest of telemetry/ is exempt (exporters' attention
     # dump is an offline boundary)
+    # quality.py and exemplar.py (ISSUE 19) run on the serve detok
+    # thread per request — the quality plane's zero-new-syncs claim is
+    # exactly this lint
     for mod in ("tracectx.py", "promtext.py", "slo.py", "profwin.py",
-                "fleet.py", "blackbox.py"):
+                "fleet.py", "blackbox.py", "quality.py", "exemplar.py"):
         targets.append(os.path.join(REPO, "sat_tpu", "telemetry", mod))
     # the encoder-quantization pass runs at serve load time inside the
     # engine boot path: its one-time calibration host syncs must be
@@ -364,6 +367,7 @@ def test_telemetry_core_is_jax_free():
         "from sat_tpu import telemetry\n"
         "from sat_tpu.telemetry import exporters, heartbeat, spans\n"
         "from sat_tpu.telemetry import blackbox, fleet, profwin, promtext, slo, tracectx\n"
+        "from sat_tpu.telemetry import exemplar, quality\n"
         "stamp = telemetry.bench_stamp()\n"
         "assert 'jax' not in sys.modules, 'telemetry core pulled in jax'\n"
         "assert 'platform' not in stamp['device']\n"

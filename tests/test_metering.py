@@ -282,10 +282,15 @@ def test_capacity_model_rate_limit_and_sketch_gauge():
     model = CapacityModel(tel, ledger, slots=2, interval_s=1.0,
                           sketch=sketch, clock=lambda: now[0])
     now[0] = 0.5
-    model.maybe_update()  # inside the interval: publishes nothing
-    assert "capacity/headroom_pct" not in tel.gauges()
+    # the first publish bypasses the rate limit — a scrape that lands
+    # before any update must never see an empty capacity block
+    model.maybe_update()
+    assert tel.gauges()["capacity/headroom_pct"] == 100.0
     sketch.observe(1)
     sketch.observe(1)
+    now[0] = 0.9
+    model.maybe_update()  # inside the interval now: publishes nothing new
+    assert "capacity/encode_cache_would_hit_ratio" not in tel.gauges()
     now[0] = 1.5
     model.maybe_update()
     g = tel.gauges()

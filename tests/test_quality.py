@@ -1,0 +1,804 @@
+"""Caption-quality observability plane tests (ISSUE 19).
+
+Pins the contracts of sat_tpu/telemetry/quality.py + exemplar.py and
+their serve/bulk wiring:
+
+* signal extraction units — margin, normalized log-prob, distinct /
+  repeat / unk rates, eos truncation, and the host attention
+  diagnostics' IDENTITY with the PR 4 device taps (same formulas,
+  B=1 masked);
+* streaming sketches + PSI edges, reference freeze / JSON round-trip,
+  outlier verdicts and the per-tenant cut;
+* the exemplar flight recorder — rotation, image size cap, disk
+  budget, torn-tail-tolerant reads, rate limiting;
+* serve integration on a real warmed engine: /stats quality block,
+  GET /quality_reference export, scripts/replay_exemplar.py replaying
+  a captured request BITWISE through a fresh subprocess engine;
+* the off-knob: ``--serve_quality off`` captions bit-identically to
+  quality-on (alphas are passive passengers of beam selection) and the
+  quality path never compiles anything new in steady state;
+* bulk stamping: quality-on shard rows carry deterministic ``quality``
+  fields and stay byte-identical across reruns; quality-off rows carry
+  none.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sat_tpu import telemetry
+from sat_tpu.config import Config
+from sat_tpu.telemetry import quality as Q
+from sat_tpu.telemetry import exemplar as E
+from sat_tpu.telemetry.quality import (
+    FixedBinSketch,
+    QualityMonitor,
+    QualityReference,
+    caption_divergence,
+    extract_signals,
+    host_attention_entropy,
+    host_coverage_deviation,
+    psi,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# signal extraction units
+# ---------------------------------------------------------------------------
+
+
+def _beam_arrays(rows, scores):
+    """rows: list of id-lists (beams, padded to longest); → (words [K,T],
+    lengths [K], scores [K])."""
+    T = max(len(r) for r in rows)
+    words = np.zeros((len(rows), T), np.int32)
+    lengths = np.zeros((len(rows),), np.int32)
+    for k, r in enumerate(rows):
+        words[k, : len(r)] = r
+        lengths[k] = len(r)
+    return words, lengths, np.asarray(scores, np.float32)
+
+
+class TestSignals:
+    def test_margin_and_norm_logprob(self):
+        words, lengths, scores = _beam_arrays(
+            [[3, 4, 5, 9], [3, 4, 9, 0]], [-2.0, -3.5]
+        )
+        sig = extract_signals(
+            words, lengths, scores, vocab_size=20, eos_id=9
+        )
+        assert sig["margin"] == pytest.approx(1.5)
+        assert sig["norm_logprob"] == pytest.approx(-2.0 / 4)
+        assert sig["caption_len"] == 4.0
+        assert sig["eos_trunc"] == 0.0
+        assert "coverage_dev" not in sig  # no alphas drained
+
+    def test_single_beam_margin_zero(self):
+        words, lengths, scores = _beam_arrays([[3, 9]], [-1.0])
+        sig = extract_signals(
+            words, lengths, scores, vocab_size=20, eos_id=9
+        )
+        assert sig["margin"] == 0.0
+
+    def test_unk_rate_counts_pad_and_oov(self):
+        # 0 (pad), vocab_size, vocab_size+5 are all OOV; 3 is real
+        words, lengths, scores = _beam_arrays(
+            [[0, 20, 25, 3], [3, 3, 3, 3]], [-1.0, -2.0]
+        )
+        sig = extract_signals(
+            words, lengths, scores, vocab_size=20, eos_id=9
+        )
+        assert sig["unk_rate"] == pytest.approx(3 / 4)
+        assert sig["eos_trunc"] == 1.0  # no eos id anywhere
+
+    def test_distinct_and_repeat_bigram(self):
+        words, lengths, scores = _beam_arrays(
+            [[3, 4, 3, 4, 3, 4], [3, 3, 3, 3, 3, 3]], [-1.0, -2.0]
+        )
+        sig = extract_signals(
+            words, lengths, scores, vocab_size=20, eos_id=9
+        )
+        assert sig["distinct_ratio"] == pytest.approx(2 / 6)
+        # bigrams: (3,4)x3 + (4,3)x2 -> 2 distinct of 5
+        assert sig["repeat_bigram"] == pytest.approx(1.0 - 2 / 5)
+
+    def test_degenerate_length_clamped(self):
+        words, lengths, scores = _beam_arrays([[9], [9]], [-1.0, -1.5])
+        lengths[:] = 0  # all-eos-first rows harvest as length 0
+        sig = extract_signals(
+            words, lengths, scores, vocab_size=20, eos_id=9
+        )
+        assert sig["caption_len"] == 1.0
+        assert sig["repeat_bigram"] == 0.0
+
+    def test_coverage_deviation_matches_device_tap(self):
+        """host_coverage_deviation == telemetry/device.py's training tap
+        for B=1 with a first-``steps`` mask — one definition of the
+        paper's doubly-stochastic deviation, device and host."""
+        import jax.numpy as jnp
+
+        from sat_tpu.telemetry.device import (
+            alpha_coverage_deviation,
+            attention_entropy,
+        )
+
+        rng = np.random.default_rng(7)
+        T, N, steps = 12, 9, 8
+        raw = rng.uniform(0.1, 1.0, (T, N)).astype(np.float32)
+        alphas = raw / raw.sum(-1, keepdims=True)
+        mask = np.zeros((1, T), np.float32)
+        mask[0, :steps] = 1.0
+        dev_cov = float(
+            alpha_coverage_deviation(jnp.asarray(alphas[None]), jnp.asarray(mask))
+        )
+        dev_ent = float(
+            attention_entropy(jnp.asarray(alphas[None]), jnp.asarray(mask))
+        )
+        assert host_coverage_deviation(alphas, steps) == pytest.approx(
+            dev_cov, rel=1e-5
+        )
+        assert host_attention_entropy(alphas, steps) == pytest.approx(
+            dev_ent, rel=1e-5
+        )
+
+    def test_attention_diag_edges(self):
+        alphas = np.full((4, 8), 1.0 / 8, np.float32)
+        # uniform rows: entropy ln(8), coverage sums to steps/8 per cell
+        assert host_attention_entropy(alphas, 4) == pytest.approx(
+            np.log(8), rel=1e-5
+        )
+        assert host_attention_entropy(alphas, 0) == 0.0
+        one_hot = np.zeros((4, 8), np.float32)
+        one_hot[:, 2] = 1.0
+        assert host_attention_entropy(one_hot, 4) == pytest.approx(0.0, abs=1e-6)
+        # steps clamped to T
+        assert host_coverage_deviation(alphas, 99) == host_coverage_deviation(
+            alphas, 4
+        )
+
+
+# ---------------------------------------------------------------------------
+# sketches, PSI, reference round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestSketchPsi:
+    def test_window_rotation_is_bounded(self):
+        s = FixedBinSketch(0.0, 1.0, bins=4, window=8)
+        for i in range(50):
+            s.update(i % 10 / 10.0)
+        assert s.total == 8
+        assert sum(s.counts) == 8
+        assert abs(sum(s.probs()) - 1.0) < 1e-9
+
+    def test_tails_clamp_into_terminal_bins(self):
+        s = FixedBinSketch(0.0, 1.0, bins=4, window=8)
+        s.update(-99.0)
+        s.update(99.0)
+        assert s.counts[0] == 1 and s.counts[-1] == 1
+
+    def test_mean_tracks_window(self):
+        s = FixedBinSketch(0.0, 10.0, bins=4, window=4)
+        for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+            s.update(v)
+        assert s.mean() == pytest.approx((2 + 3 + 4 + 5) / 4)
+
+    def test_psi_edges(self):
+        assert psi([0.5, 0.5], [0.5, 0.5]) == 0.0
+        assert psi([], []) == 0.0
+        assert psi([0.0, 0.0], [0.5, 0.5]) == 0.0  # empty side: no evidence
+        shifted = psi([1.0, 0.0], [0.0, 1.0])
+        assert shifted > 0.25  # fully moved mass is far past "investigate"
+        assert psi([0.6, 0.4], [0.5, 0.5]) < shifted
+
+    def test_reference_round_trip_through_json(self, tmp_path):
+        sketches = {
+            name: FixedBinSketch(lo, hi, bins=8, window=32)
+            for name, lo, hi in Q.SIGNALS
+        }
+        rng = np.random.default_rng(3)
+        for _ in range(32):
+            for name, lo, hi in Q.SIGNALS:
+                sketches[name].update(rng.uniform(lo, hi))
+        ref = QualityReference.from_sketches(
+            sketches, fingerprint={"model_step": 7}
+        )
+        path = str(tmp_path / "quality_reference.json")
+        ref.save(path)
+        back = QualityReference.load(path)
+        assert back.fingerprint == {"model_step": 7}
+        for name, _lo, _hi in Q.SIGNALS:
+            # PSI of a distribution against its own round-trip is ~0
+            assert psi(sketches[name].probs(), back.probs[name]) < 1e-6
+            assert back.counts[name] == 32
+
+    def test_reference_schema_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            QualityReference.from_payload({"schema_version": 99})
+
+
+# ---------------------------------------------------------------------------
+# streaming monitor: freeze, outliers, drift, per-tenant cut
+# ---------------------------------------------------------------------------
+
+
+def _sig(margin=2.0, unk=0.0, eos_trunc=0.0, norm=-1.0, cov=0.1):
+    return {
+        "margin": margin,
+        "norm_logprob": norm,
+        "caption_len": 8.0,
+        "distinct_ratio": 0.9,
+        "repeat_bigram": 0.0,
+        "unk_rate": unk,
+        "eos_trunc": eos_trunc,
+        "coverage_dev": cov,
+        "attn_entropy": 3.0,
+    }
+
+
+class TestMonitor:
+    def test_warmup_freeze_and_drift(self):
+        tel = telemetry.enable(capacity=4096)
+        try:
+            m = QualityMonitor(window=16, tel=tel)
+            for _ in range(16):
+                assert m.observe(_sig()) == []
+            assert m.reference is not None
+            assert m.reference_source == "warmup"
+            assert m.drift_scores()  # same traffic → all ~0
+            assert max(m.drift_scores().values()) < 1e-6
+            # hard shift: margin collapses, norm_logprob drops
+            reasons = None
+            for _ in range(16):
+                reasons = m.observe(_sig(margin=9.5, norm=-9.0))
+            assert "drift_margin" in reasons
+            assert "drift_norm_logprob" in reasons
+            scores = m.drift_scores()
+            assert scores["margin"] > 0.25
+            m.maybe_publish(force=True)
+            gauges = tel.gauges()
+            assert gauges["quality/psi_max"] > 0.25
+            assert gauges["quality/reference_frozen"] == 1
+            snap = m.snapshot()
+            assert snap["requests"] == 32
+            assert snap["outliers"] >= 16
+            assert snap["psi_max"] == gauges["quality/psi_max"]
+        finally:
+            telemetry.disable()
+
+    def test_threshold_outliers(self):
+        m = QualityMonitor(window=16, margin_min=0.5, unk_max=0.2)
+        assert "low_margin" in m.observe(_sig(margin=0.1))
+        assert "high_unk" in m.observe(_sig(unk=0.9))
+        assert "eos_trunc" in m.observe(_sig(eos_trunc=1.0))
+        assert m.observe(_sig()) == []
+        assert m.outliers == 3
+
+    def test_file_reference_skips_warmup_freeze(self):
+        sketches = {
+            name: FixedBinSketch(lo, hi, bins=16, window=8)
+            for name, lo, hi in Q.SIGNALS
+        }
+        for _ in range(8):
+            for name, value in _sig().items():
+                sketches[name].update(value)
+        ref = QualityReference.from_sketches(sketches)
+        m = QualityMonitor(window=16, reference=ref)
+        assert m.reference_source == "file"
+        # drift scoring live from request one — no warmup window needed
+        reasons = m.observe(_sig(margin=9.9))
+        assert "drift_margin" in reasons
+
+    def test_per_tenant_cut(self):
+        tel = telemetry.enable(capacity=4096)
+        try:
+            m = QualityMonitor(window=8, tel=tel)
+            for _ in range(8):
+                m.observe(_sig(), tenant="steady")
+            for _ in range(8):
+                m.observe(_sig(margin=9.5, norm=-9.5), tenant="skewed")
+            m.maybe_publish(force=True)
+            snap = m.snapshot()
+            assert set(snap["tenants"]) == {"steady", "skewed"}
+            assert snap["tenants"]["skewed"]["psi_max"] > 0.25
+            assert snap["tenants"]["steady"]["psi_max"] < 0.05
+            gauges = tel.gauges()
+            assert gauges["quality/tenant_skewed_psi_max"] > 0.25
+        finally:
+            telemetry.disable()
+
+    def test_publish_rate_limited_by_injectable_clock(self):
+        tel = telemetry.enable(capacity=4096)
+        try:
+            now = [0.0]
+            m = QualityMonitor(
+                window=8, publish_interval_s=1.0, tel=tel,
+                clock=lambda: now[0],
+            )
+            for _ in range(8):
+                m.observe(_sig())
+            tel.gauge("quality/requests", -1)  # sentinel to detect refresh
+            m.observe(_sig())  # same tick: publish suppressed
+            assert tel.gauges()["quality/requests"] == -1
+            now[0] += 1.5
+            m.observe(_sig())  # interval elapsed: gauges refresh
+            assert tel.gauges()["quality/requests"] == 10
+        finally:
+            telemetry.disable()
+
+
+class TestDivergenceShared:
+    def test_divergence_values(self):
+        assert caption_divergence("a dog runs.", "a dog runs.") == 0.0
+        assert caption_divergence("a dog", "two cats") == 1.0
+        assert caption_divergence("", "") == 0.0
+        assert 0.0 < caption_divergence("a dog runs", "a cat runs") < 1.0
+
+    def test_canary_reexports_the_shared_definition(self):
+        """One quality module serves both planes: the lifecycle canary's
+        divergence IS telemetry.quality's (ISSUE 19 refactor)."""
+        from sat_tpu.lifecycle import canary
+
+        assert canary.caption_divergence is Q.caption_divergence
+        assert canary.DivergenceGauge is Q.DivergenceGauge
+
+
+# ---------------------------------------------------------------------------
+# exemplar flight recorder
+# ---------------------------------------------------------------------------
+
+
+def _recorder(tmp_path, **kw):
+    now = [0.0]
+
+    def clock():
+        now[0] += 1.0  # every record lands outside the rate-limit window
+        return now[0]
+
+    kw.setdefault("clock", clock)
+    return E.ExemplarRecorder(str(tmp_path / "ex"), **kw)
+
+
+class TestExemplarRecorder:
+    def test_record_and_read_back(self, tmp_path):
+        r = _recorder(tmp_path)
+        r.write_meta({"config": {"beam_size": 2}, "model_step": 5})
+        assert r.record(
+            reasons=["low_margin"], request_id="r1", tenant="t",
+            caption="a dog.", beams=[{"caption": "a dog."}],
+            signals={"margin": 0.125}, image_bytes=b"JPEGDATA",
+            alphas=np.ones((2, 3, 4), np.float32),
+        )
+        rows, torn = E.read_exemplars(r.dir)
+        assert torn == 0 and len(rows) == 1
+        row = rows[0]
+        assert row["reasons"] == ["low_margin"]
+        assert row["signals"]["margin"] == 0.125
+        assert row["alphas_digest"] == E.alphas_digest(
+            np.ones((2, 3, 4), np.float32)
+        )
+        assert E.load_image(r.dir, row) == b"JPEGDATA"
+        assert E.read_meta(r.dir)["model_step"] == 5
+        assert row["image"].startswith("img_") and row["image"].endswith(".bin")
+
+    def test_rate_limit_drops_storms(self, tmp_path):
+        r = E.ExemplarRecorder(
+            str(tmp_path / "ex"), min_interval_s=10.0, clock=lambda: 100.0
+        )
+        assert r.record(reasons=["a"])
+        assert not r.record(reasons=["b"])  # same instant: dropped
+        assert r.stats() == {"recorded": 1, "dropped": 1}
+
+    def test_segment_rotation_bounds_rows(self, tmp_path):
+        r = _recorder(tmp_path, segment_rows=2, segments=3)
+        for i in range(9):
+            assert r.record(reasons=[f"r{i}"])
+        segs = sorted(
+            f for f in os.listdir(r.dir) if f.startswith("seg_")
+        )
+        assert len(segs) <= 3
+        rows, _ = E.read_exemplars(r.dir)
+        # ring of 3 segments x 2 rows: the oldest rows rotated away
+        assert 0 < len(rows) <= 6
+        reasons = {row["reasons"][0] for row in rows}
+        assert "r8" in reasons  # newest survives
+        assert "r0" not in reasons  # oldest rotated out
+
+    def test_image_size_cap_keeps_metadata(self, tmp_path):
+        r = _recorder(tmp_path, image_cap_kb=1.0)
+        assert r.record(reasons=["big"], image_bytes=b"x" * 2048)
+        rows, _ = E.read_exemplars(r.dir)
+        assert rows[0]["image"] is None
+        assert rows[0]["image_bytes"] == 2048
+        assert E.load_image(r.dir, rows[0]) is None
+
+    def test_disk_budget_evicts_oldest(self, tmp_path):
+        r = _recorder(
+            tmp_path, budget_mb=8 / 1024.0, segment_rows=4, segments=2
+        )  # 8 KiB budget
+        for i in range(6):
+            r.record(
+                reasons=["x"], image_bytes=bytes([i]) * 3000
+            )  # distinct 3 KB images
+        total = sum(
+            os.path.getsize(os.path.join(r.dir, f))
+            for f in os.listdir(r.dir)
+        )
+        assert total <= 8 * 1024 + 4096  # budget + one in-flight row
+        assert os.path.exists(os.path.join(r.dir, "seg_%03d.jsonl" % r._idx))
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        r = _recorder(tmp_path)
+        r.record(reasons=["ok"])
+        seg = os.path.join(r.dir, "seg_000.jsonl")
+        with open(seg, "a") as f:
+            f.write('{"t_unix": 99, "reasons": ["torn')  # killed mid-append
+        rows, torn = E.read_exemplars(r.dir)
+        assert torn == 1
+        assert [row["reasons"] for row in rows] == [["ok"]]
+
+    def test_recorder_survives_unwritable_dir(self, capsys):
+        r = E.ExemplarRecorder("/proc/definitely/not/writable")
+        assert not r.record(reasons=["x"])  # warns once, never raises
+        assert r.stats()["recorded"] == 0
+
+
+# ---------------------------------------------------------------------------
+# serve integration: warmed engine, HTTP surface, bitwise replay
+# ---------------------------------------------------------------------------
+
+
+SENTENCES = [
+    "a man riding a horse on the beach.",
+    "a group of people standing around a kitchen.",
+    "two dogs playing with a red ball in the grass.",
+]
+
+
+def _jpeg(i, size=32):
+    import cv2
+
+    rng = np.random.default_rng(100 + i)
+    img = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+    img[: 8 + i, :, i % 3] = 40 * (i + 1) % 255
+    ok, buf = cv2.imencode(".jpg", img)
+    assert ok
+    return bytes(buf)
+
+
+@pytest.fixture(scope="module")
+def qserve(tmp_path_factory):
+    """Fresh tiny checkpoint + quality-ON warmed engine + HTTP server.
+
+    Procedural params (no training) — quality plumbing is orthogonal to
+    caption merit, and the fixture stays fast."""
+    import jax
+
+    from sat_tpu import runtime
+    from sat_tpu.data.vocabulary import Vocabulary
+    from sat_tpu.resilience import lineage
+    from sat_tpu.serve.engine import ServeEngine, load_serving_state
+    from sat_tpu.serve.server import CaptionServer
+    from sat_tpu.train.checkpoint import save_checkpoint
+    from sat_tpu.train.step import create_train_state
+
+    root = str(tmp_path_factory.mktemp("quality_serve"))
+    vocab_file = os.path.join(root, "vocabulary.csv")
+    vocabulary = Vocabulary(size=50)
+    vocabulary.build(SENTENCES)
+    vocabulary.save(vocab_file)
+    config = Config(
+        phase="serve",
+        image_size=32,
+        dim_embedding=16,
+        num_lstm_units=16,
+        dim_initialize_layer=16,
+        dim_attend_layer=16,
+        dim_decode_layer=32,
+        compute_dtype="float32",
+        vocabulary_size=vocabulary.size,
+        vocabulary_file=vocab_file,
+        beam_size=2,
+        save_dir=os.path.join(root, "models"),
+        summary_dir=os.path.join(root, "summary"),
+        serve_buckets=(1, 4),
+        serve_max_batch=4,
+        serve_max_wait_ms=5.0,
+        heartbeat_interval=0.0,
+        serve_quality="on",
+        serve_quality_window=8,
+        serve_quality_exemplar_dir=os.path.join(root, "exemplars"),
+    )
+    os.makedirs(config.save_dir, exist_ok=True)
+    tel = telemetry.enable(capacity=1 << 16)
+    runtime._install_compile_listener()
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    save_checkpoint(state, config)
+    lineage.mark_last_good(config.save_dir, int(np.asarray(state.step)))
+    state, _ = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+    server = CaptionServer(config, engine, port=0).start()
+    yield {
+        "config": config,
+        "engine": engine,
+        "server": server,
+        "tel": tel,
+        "root": root,
+        "vocabulary": vocabulary,
+    }
+    server.shutdown()
+    telemetry.disable()
+
+
+def _post(port, data):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/caption",
+        data=data,
+        method="POST",
+        headers={"Content-Type": "image/jpeg"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, route):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{route}", timeout=10
+    ) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_serve_quality_block_and_reference_export(qserve):
+    port = qserve["server"].port
+    # /quality_reference 409s until a full window froze one
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        _get(port, "/quality_reference")
+    assert exc_info.value.code == 409
+    for i in range(10):
+        status, body = _post(port, _jpeg(i % 3))
+        assert status == 200 and body["captions"]
+    status, stats = _get(port, "/stats")
+    q = stats["quality"]
+    assert q["requests"] >= 10
+    assert q["reference"] == "warmup"
+    assert "exemplars" in q
+    status, payload = _get(port, "/quality_reference")
+    assert status == 200
+    ref = QualityReference.from_payload(payload)  # round-trips
+    assert ref.counts["margin"] == qserve["config"].serve_quality_window
+    # the heartbeat/scrape carriers see the same gauges
+    gauges = qserve["tel"].gauges()
+    assert gauges.get("quality/reference_frozen") == 1
+    assert "quality/psi_max" in gauges
+
+
+def test_quality_requests_never_recompile(qserve):
+    tel = qserve["tel"]
+    port = qserve["server"].port
+    compiles0 = tel.counters().get("jax/compiles", 0)
+    for i in range(4):
+        status, _ = _post(port, _jpeg(i % 3))
+        assert status == 200
+    assert tel.counters().get("jax/compiles", 0) == compiles0
+
+
+def test_off_knob_captions_bit_identical(qserve):
+    """serve_quality=off must caption bit-identically: alphas are
+    passengers of the drained result, never inputs to beam selection."""
+    from sat_tpu.serve.engine import ServeEngine, load_serving_state
+
+    engine_on = qserve["engine"]
+    config_off = qserve["config"].replace(serve_quality="off")
+    state, _ = load_serving_state(config_off)
+    engine_off = ServeEngine(
+        config_off, state, qserve["vocabulary"], tel=qserve["tel"]
+    )
+    engine_off.warmup()
+    imgs = [engine_on.preprocess(_jpeg(i)) for i in range(3)]
+    out_on = engine_on.dispatch(engine_on.pad_batch(imgs)[0])
+    out_off = engine_off.dispatch(engine_off.pad_batch(imgs)[0])
+    won, lon, son, aon = engine_on.drain_output(out_on, 3)
+    woff, loff, soff, aoff = engine_off.drain_output(out_off, 3)
+    assert aon is not None and aoff is None  # the only difference
+    assert np.array_equal(won, woff)
+    assert np.array_equal(lon, loff)
+    assert np.array_equal(son, soff)
+    assert engine_on.detok_rows((won, lon, son, aon), 3) == (
+        engine_off.detok_rows((woff, loff, soff), 3)
+    )
+
+
+def test_exemplar_replay_bitwise_subprocess(qserve):
+    """The full flight-recorder loop: capture an exemplar off the live
+    server, then scripts/replay_exemplar.py boots a FRESH engine from
+    meta.json in a subprocess and must reproduce the caption bitwise."""
+    server = qserve["server"]
+    jpeg = _jpeg(1)
+    status, body = _post(server.port, jpeg)
+    assert status == 200
+    caption = body["captions"][0]["caption"]
+    # the recorder rate-limits (outliers from live traffic may have just
+    # landed one); retry past the window rather than flake
+    for _ in range(10):
+        if server.exemplars.record(
+            reasons=["test_capture"],
+            request_id="replay-e2e",
+            caption=caption,
+            beams=body["captions"],
+            image_bytes=jpeg,
+        ):
+            break
+        time.sleep(0.3)
+    else:
+        pytest.fail("exemplar record kept hitting the rate limiter")
+    exdir = server.exemplars.dir
+    assert E.read_meta(exdir)["model_step"] == qserve["engine"].step
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "replay_exemplar.py"),
+            "--dir", exdir, "--request-id", "replay-e2e",
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    verdicts = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    assert verdicts and verdicts[-1]["verdict"] == "BITWISE MATCH"
+    assert verdicts[-1]["replayed"] == caption
+
+
+def test_terminal_exemplar_on_shed(qserve):
+    """Queue-full sheds record terminal exemplars (no caption, the raw
+    request preserved) — the 'what were we shedding' flight record."""
+    server = qserve["server"]
+    time.sleep(0.3)  # clear the recorder's rate-limit window
+    recorded0 = server.exemplars.stats()["recorded"]
+    server._record_terminal_exemplar(
+        type("T", (), {"trace_id": "shed-1"})(), 429, "shed", "default",
+        b"rawbytes",
+    )
+    assert server.exemplars.stats()["recorded"] == recorded0 + 1
+    rows, _ = E.read_exemplars(server.exemplars.dir)
+    mine = [r for r in rows if r["request_id"] == "shed-1"]
+    assert mine and mine[0]["reasons"] == ["shed"]
+    assert mine[0]["status"] == 429
+    assert E.load_image(server.exemplars.dir, mine[0]) == b"rawbytes"
+
+
+# ---------------------------------------------------------------------------
+# SLO lanes + healthz posture
+# ---------------------------------------------------------------------------
+
+
+def test_quality_slo_lanes_from_config():
+    from sat_tpu.telemetry.slo import objectives_from_config
+
+    config = Config(
+        phase="serve", slo_quality_psi=0.25, slo_quality_unk=0.1
+    )
+    lanes = {o.name: o for o in objectives_from_config(config, "serve")}
+    assert lanes["quality_drift"].kind == "gauge_ceiling"
+    assert lanes["quality_drift"].source == "quality/psi_max"
+    assert lanes["quality_unk"].source == "quality/unk_rate"
+    # 0 disables (the config default)
+    off = objectives_from_config(Config(phase="serve"), "serve")
+    assert not any(o.name.startswith("quality_") for o in off)
+
+
+def test_quality_burn_is_diagnostic_not_degrading(qserve):
+    """A quality_* lane burning must not flip /healthz: drift is a model
+    problem — routing traffic away fixes nothing (same posture as the
+    tenant lanes).  A service lane burning still degrades."""
+    server = qserve["server"]
+    orig = server.slo.burning
+    try:
+        server.slo.burning = lambda: ["quality_drift", "tenant_a_latency"]
+        health, status = server.healthz()
+        assert health["status"] == "ok" and status == 200
+        server.slo.burning = lambda: ["quality_drift", "p95_latency"]
+        health, status = server.healthz()
+        assert health["status"] == "degraded"
+    finally:
+        server.slo.burning = orig
+    health, status = server.healthz()
+    assert status == 200
+
+
+# ---------------------------------------------------------------------------
+# bulk stamping: deterministic quality fields in shard rows
+# ---------------------------------------------------------------------------
+
+
+def test_bulk_rows_stamp_quality_deterministically(qserve):
+    from sat_tpu.bulk.runner import run_bulk
+
+    root = qserve["root"]
+    img_dir = os.path.join(root, "bulk_imgs")
+    os.makedirs(img_dir, exist_ok=True)
+    for i in range(4):
+        with open(os.path.join(img_dir, f"img_{i}.jpg"), "wb") as f:
+            f.write(_jpeg(i))
+
+    def run(name, quality):
+        cfg = qserve["config"].replace(
+            phase="bulk",
+            serve_quality=quality,
+            serve_slot_pages=2,
+            serve_page_width=2,
+            shard_cache="off",
+            bulk_input=img_dir,
+            bulk_output=os.path.join(root, name),
+            bulk_shard_rows=2,
+            serve_quality_exemplar_dir="",
+        )
+        assert run_bulk(cfg) == 0
+        return {
+            f: open(os.path.join(cfg.bulk_output, f), "rb").read()
+            for f in sorted(os.listdir(cfg.bulk_output))
+            if f.startswith("captions_") and f.endswith(".jsonl")
+        }
+
+    on_a = run("bulk_on_a", "on")
+    on_b = run("bulk_on_b", "on")
+    off = run("bulk_off", "off")
+    assert on_a == on_b  # byte-identical rerun: stamping is deterministic
+    rows_on = [
+        json.loads(l)
+        for blob in on_a.values()
+        for l in blob.decode().splitlines()
+    ]
+    rows_off = [
+        json.loads(l)
+        for blob in off.values()
+        for l in blob.decode().splitlines()
+    ]
+    assert all("quality" in r for r in rows_on)
+    for r in rows_on:
+        assert set(r["quality"]) == {
+            "margin", "norm_logprob", "unk_rate", "coverage_dev"
+        }
+    assert all("quality" not in r for r in rows_off)
+    # quality is a pure addition: captions match the off run exactly
+    strip = lambda rows: [
+        {k: v for k, v in r.items() if k != "quality"} for r in rows
+    ]
+    assert strip(rows_on) == strip(rows_off)
+
+
+# ---------------------------------------------------------------------------
+# router fan-in (jax-free dict arithmetic)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_quality_fan_in():
+    from sat_tpu.serve.router import fleet_quality
+
+    replicas = {
+        "r0": {"quality": {"requests": 10, "outliers": 1,
+                           "psi_max": 0.02, "reference": "warmup"}},
+        "r1": {"quality": {"requests": 30, "outliers": 6,
+                           "psi_max": 0.41, "reference": "file"}},
+        "r2": {},  # replica without a quality plane: skipped, not summed
+    }
+    fq = fleet_quality(replicas)
+    assert fq["requests"] == 40
+    assert fq["outliers"] == 7
+    assert fq["psi_max"] == 0.41  # WORST replica, never the average
+    assert fq["worst_replica"] == "r1"
+    assert set(fq["replicas"]) == {"r0", "r1"}
+    assert fleet_quality({"r0": {}}) == {}

@@ -136,14 +136,26 @@ def test_watchdog_untracked_phase_never_enforced(tmp_path):
 
 def test_slow_but_alive_steps_keep_watchdog_quiet(tmp_path):
     """SAT_FI_SLOW_STEP_MS semantics: a degraded-but-progressing loop
-    completes its phases and must never climb the ladder."""
+    completes its phases and must never climb the ladder.  Driven on a
+    fake clock (``use_clock``) so "slow but under deadline" is exact —
+    the wall-clock version raced suite CPU contention and flaked when a
+    5 ms stall ran past the 50 ms deadline on a loaded host."""
     plan = FaultPlan(slow_step_ms=5)
+    now = [0.0]
     wd, aborts = _make_wd(tmp_path, {"step": 0.05})
+    wd.use_clock(lambda: now[0])
     for step in range(5):
         with wd.phase("step"):
-            plan.maybe_slow(step)
+            plan.maybe_slow(step)  # real stall; watchdog time is frozen
+            now[0] += 0.04  # each step runs 40 ms on the fake clock
         wd.check()
     assert wd.state == OK and aborts == []
+    # same cadence past the deadline DOES climb: proves the fake-clock
+    # harness still exercises enforcement, not a disconnected timer
+    wd._enter("step")
+    now[0] += 0.06
+    wd.check()
+    assert wd.state == STALLED
 
 
 def test_watchdog_threaded_smoke(tmp_path):
