@@ -249,6 +249,31 @@ def build_config(argv: Optional[List[str]] = None):
              "default Config.serve_metering=True",
     )
     p.add_argument(
+        "--encode_cache", choices=("on", "off"), default=None,
+        help="serve phase: device-resident content-addressed LRU of "
+             "encoder feature grids keyed by (image crc32c, param "
+             "fingerprint, quant mode) — a hit skips the encode lane, a "
+             "miss encodes once with single-flight coalescing "
+             "(docs/SERVING.md 'Encode cache & tiered fleets'; default "
+             "Config.encode_cache='off', bit-identical to pre-cache "
+             "serving)",
+    )
+    p.add_argument(
+        "--encode_cache_mb", type=int, default=None,
+        help="serve phase: HBM budget for the encode-cache feature-grid "
+             "ring (fixed geometry, sized at warmup; default "
+             "Config.encode_cache_mb=64)",
+    )
+    p.add_argument(
+        "--serve_tier", choices=("both", "encode", "decode"), default=None,
+        help="serve phase: fleet tier this replica advertises — 'encode' "
+             "(stateless POST /encode feature-grid tier), 'decode' "
+             "(latency tier fed grids), or 'both' (default; untiered). "
+             "Routing metadata only: every replica still answers direct "
+             "image captions (docs/SERVING.md 'Encode cache & tiered "
+             "fleets')",
+    )
+    p.add_argument(
         "--serve_quality", choices=("on", "off"), default=None,
         help="serve phase: caption-quality observability plane — "
              "per-request quality signals at the detok boundary, "
@@ -456,6 +481,12 @@ def build_config(argv: Optional[List[str]] = None):
         config = config.replace(tenants=args.tenants)
     if args.serve_metering is not None:
         config = config.replace(serve_metering=args.serve_metering == "on")
+    if args.encode_cache is not None:
+        config = config.replace(encode_cache=args.encode_cache)
+    if args.encode_cache_mb is not None:
+        config = config.replace(encode_cache_mb=args.encode_cache_mb)
+    if args.serve_tier is not None:
+        config = config.replace(serve_tier=args.serve_tier)
     if args.serve_quality is not None:
         config = config.replace(serve_quality=args.serve_quality)
     if args.quality_reference is not None:

@@ -260,6 +260,28 @@ class Config:
     # active when telemetry is on (all attribution rides telemetry-gated
     # already-synced boundaries); off skips ledger and gauges entirely.
     serve_metering: bool = True
+    # ---- content-addressed encode cache (sat_tpu/serve/encode_cache.py;
+    # ---- docs/SERVING.md "Encode cache & tiered fleets") ----
+    # "on" keeps a device-resident LRU of encoder feature grids keyed by
+    # (image crc32c, param fingerprint, quant mode): a hit skips the
+    # encode lane entirely and seeds the slot from the cached grid, a
+    # miss encodes once and inserts (single-flight — N concurrent
+    # requests for one image trigger exactly one encode).  The ring is
+    # fixed-geometry HBM with AOT-warmed insert/gather executables, so
+    # steady state never recompiles; "off" (default) never constructs
+    # the cache and is bit-identical to pre-cache serving.
+    encode_cache: str = "off"
+    encode_cache_mb: int = 64          # HBM budget for the feature-grid ring
+    # ---- encode/decode tier disaggregation (serve/router.py) ----
+    # which serve functions this replica advertises to the fleet router:
+    # "both" (default) serves images end to end; "encode" is the
+    # stateless batch-friendly tier (POST /encode returns a feature-grid
+    # handoff blob); "decode" is the latency-bound tier fed grids via
+    # POST /caption with the sat-grid content type.  The tier is routing
+    # metadata, not a capability restriction — every replica still
+    # answers direct image captions, so a tiered fleet degrades to
+    # untiered serving instead of 404ing when the router is bypassed.
+    serve_tier: str = "both"
     # ---- caption-quality observability (telemetry/quality.py, ----
     # ---- telemetry/exemplar.py; docs/OBSERVABILITY.md "Quality") ----
     # "on" threads the harvested beam alphas through the existing detok
@@ -493,6 +515,8 @@ class Config:
             ("anomaly_policy", ("off", "warn", "skip", "rollback")),
             ("diag_level", ("off", "basic", "full")),
             ("encoder_quant", ("off", "bf16", "int8")),
+            ("encode_cache", ("off", "on")),
+            ("serve_tier", ("both", "encode", "decode")),
         )
         for name, allowed in checks:
             if getattr(self, name) not in allowed:
@@ -592,6 +616,11 @@ class Config:
         if self.serve_slot_pages <= 0 or self.serve_page_width <= 0:
             raise ValueError(
                 "Config.serve_slot_pages and serve_page_width must be >= 1"
+            )
+        if self.encode_cache_mb <= 0:
+            raise ValueError(
+                f"Config.encode_cache_mb={self.encode_cache_mb}: must be "
+                "> 0 (the ring needs at least one feature-grid row)"
             )
         if self.serve_quality not in ("off", "on"):
             raise ValueError(

@@ -38,6 +38,9 @@ _LAZY = {
     "BucketOverflow": ("engine", "BucketOverflow"),
     "CaptionServer": ("server", "CaptionServer"),
     "ContinuousBatcher": ("batcher", "ContinuousBatcher"),
+    "EncodeCache": ("encode_cache", "EncodeCache"),
+    "GRID_CONTENT_TYPE": ("handoff", "GRID_CONTENT_TYPE"),
+    "HandoffError": ("handoff", "HandoffError"),
     "MicroBatcher": ("batcher", "MicroBatcher"),
     "PagedSlotPool": ("slot_pool", "PagedSlotPool"),
     "Rejected": ("batcher", "Rejected"),

@@ -87,7 +87,9 @@ class Request:
     """One admitted caption request; ``done`` fires with either ``result``
     (the engine's per-image dict) or ``error`` (http status, message)."""
 
-    image: np.ndarray
+    # the preprocessed image row; None for a decode-tier request that
+    # arrived as a pre-encoded context grid (``context`` set instead)
+    image: Optional[np.ndarray]
     t_submit_ns: int
     deadline_unix: Optional[float] = None
     done: threading.Event = field(default_factory=threading.Event)
@@ -118,6 +120,14 @@ class Request:
     # the exemplar flight recorder can store a replayable copy of an
     # outlier request; None otherwise (no per-request body retention)
     raw: Optional[bytes] = None
+    # content address of the preprocessed image (crc32c of its bytes),
+    # stamped by the server when --encode_cache is on; the dispatch
+    # paths route keyed requests through the encode cache
+    key: Optional[int] = None
+    # pre-encoded [N, D] context grid (encode/decode tier handoff,
+    # serve/handoff.py): when set, dispatch seeds the slot from it and
+    # skips the encode lane — and the cache — entirely
+    context: Optional[np.ndarray] = None
 
     def mark(self, phase: str, t0_ns: int, dur_ns: int) -> None:
         if self.trace is not None:
@@ -189,14 +199,17 @@ class _BatcherBase:
 
     def submit(
         self,
-        image: np.ndarray,
+        image: Optional[np.ndarray],
         deadline_unix: Optional[float] = None,
         trace: Optional[Any] = None,
         slot: str = "incumbent",
         tenant: str = "default",
         raw: Optional[bytes] = None,
+        key: Optional[int] = None,
+        context: Optional[np.ndarray] = None,
     ) -> Request:
-        """Admit one preprocessed image; raises Rejected(503) while
+        """Admit one preprocessed image — or, on a decode-tier replica, a
+        pre-encoded ``context`` grid; raises Rejected(503) while
         draining and Rejected(429) when the tenant's queue lane is full
         (a tenant-scoped shed under a multi-tenant scheduler — one
         tenant's backlog never consumes another's queue space)."""
@@ -214,6 +227,8 @@ class _BatcherBase:
             # body bytes are retained only while this request is in
             # flight AND the quality plane wants exemplars
             raw=raw if self._exemplars is not None else None,
+            key=key,
+            context=context,
         )
         try:
             self._q.put_nowait(req)
@@ -497,10 +512,26 @@ class MicroBatcher(_BatcherBase):
 
     def _dispatch(self, live: List[Request], slot: str = "incumbent"):
         t0 = time.perf_counter_ns()
-        batch, bucket = self.engine.pad_batch([r.image for r in live])
-        out = self.engine.dispatch(
-            batch, slot=slot, costs=[r.cost for r in live]
-        )
+        if live[0].context is not None:
+            # decode-tier group (pre-encoded handoff grids): the loop
+            # groups by kind, so the whole group carries contexts
+            bucket = self.engine.pick_bucket(len(live))
+            out = self.engine.dispatch_contexts(
+                [r.context for r in live], slot=slot,
+                costs=[r.cost for r in live],
+            )
+        else:
+            batch, bucket = self.engine.pad_batch([r.image for r in live])
+            keys = [r.key for r in live]
+            if getattr(self.engine, "encode_cache", None) is None or any(
+                k is None for k in keys
+            ):
+                # unkeyed requests (cache off, or direct submit()s that
+                # never saw the server's crc stamp) take the plain path
+                keys = None
+            out = self.engine.dispatch(
+                batch, slot=slot, costs=[r.cost for r in live], keys=keys
+            )
         t1 = time.perf_counter_ns()
         self._tel.record("serve/dispatch", t0, t1 - t0)
         self._tel.count("serve/batches")
@@ -634,14 +665,18 @@ class MicroBatcher(_BatcherBase):
             live = self._admit(batch)
             if not live:
                 continue
-            # one dispatch per param slot: a gathered batch mixing canary
-            # and incumbent requests splits, so each dispatch runs against
-            # exactly one param tree
-            groups: Dict[str, List[Request]] = {}
+            # one dispatch per (param slot, payload kind): a gathered
+            # batch mixing canary and incumbent requests splits so each
+            # dispatch runs against exactly one param tree, and image vs
+            # pre-encoded-context requests split because they enter the
+            # device through different programs
+            groups: Dict[Tuple[str, bool], List[Request]] = {}
             for r in live:
-                groups.setdefault(r.slot, []).append(r)
-            for slot in sorted(groups):
-                self._dispatch_group(groups[slot], slot, inflight)
+                groups.setdefault(
+                    (r.slot, r.context is not None), []
+                ).append(r)
+            for gkey in sorted(groups):
+                self._dispatch_group(groups[gkey], gkey[0], inflight)
             while len(inflight) > self.pipeline_depth:
                 self._finish(inflight.popleft())
         while inflight:  # drain: complete what the device still owes

@@ -28,6 +28,7 @@ which is also how tests assert zero recompiles during the request phase.
 from __future__ import annotations
 
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -173,6 +174,26 @@ class ServeEngine:
         self.warm_compiles = 0
         self.warm_seconds = 0.0
         self.compiles_at_ready = 0
+        # content-addressed encode cache (--encode_cache on): constructed
+        # here, geometry fixed at warmup (engine buckets in batch mode,
+        # pool lanes in continuous mode).  None when off — every caller
+        # branches on that, so the off-knob path is byte-for-byte today's.
+        self.encode_cache = None
+        if config.encode_cache == "on":
+            from .encode_cache import EncodeCache
+
+            self.encode_cache = EncodeCache(
+                config.encode_cache_mb, tel=self._tel
+            )
+        # context-row aval (shape [N, D], dtype) — set by whichever warmup
+        # runs; the decode tier validates handoff grids against it
+        self.ctx_row_shape: Optional[Tuple[int, ...]] = None
+        self.ctx_row_dtype = None
+        # width-1 encode executable for the encode tier's POST /encode
+        # (warmed by the server when serve_tier="encode"; lazily compiled
+        # otherwise, which counts as a compile — documented in SERVING.md)
+        self._enc_one_exec = None
+        self._enc_one_lock = threading.Lock()
         # second param slot for the lifecycle plane: a candidate tree with
         # the same treedef/shapes/dtypes as the incumbent, runnable
         # through the ALREADY-WARMED executables (params are runtime
@@ -211,6 +232,18 @@ class ServeEngine:
     @property
     def candidate_step(self) -> Optional[int]:
         return None if self._candidate is None else self._candidate["step"]
+
+    def param_fingerprint(self, slot: str = "incumbent") -> Tuple:
+        """Stable identity of the params a slot resolves to right now —
+        the generation component of encode-cache keys, so a grid encoded
+        under one model can never serve a hit under another (hot-swap,
+        resident alias, or a different quant mode all change the key)."""
+        if slot == "canary" and self._candidate is not None:
+            return ("canary", self._candidate["step"], self.encoder_quant)
+        resident = self._residents.get(slot)
+        if resident is not None:
+            return (slot, resident["step"], self.encoder_quant)
+        return ("incumbent", self.step, self.encoder_quant)
 
     def _validate_compat(
         self, variables: Dict[str, Any], decoder_params, source: str,
@@ -274,6 +307,10 @@ class ServeEngine:
         self._decoder_params = cand["decoder_params"]
         self.step = cand["step"]
         self._tel.gauge("lifecycle/candidate_step", -1)
+        if self.encode_cache is not None:
+            # fingerprinted keys mean stale entries could never hit, but
+            # flushing returns their rows immediately (lifecycle coherence)
+            self.encode_cache.flush()
         return self.step
 
     def clear_candidate(self) -> None:
@@ -281,6 +318,8 @@ class ServeEngine:
         and the canary slot falls back to it for any stragglers."""
         self._candidate = None
         self._tel.gauge("lifecycle/candidate_step", -1)
+        if self.encode_cache is not None:
+            self.encode_cache.flush()
 
     # -- resident models (multi-tenant plane) ------------------------------
 
@@ -366,6 +405,17 @@ class ServeEngine:
                 **beam_kwargs,
             ).compile()
             self._compiled[b] = (enc_exec, beam_exec)
+            self.ctx_row_shape = tuple(int(d) for d in ctx_sd.shape[1:])
+            self.ctx_row_dtype = np.dtype(ctx_sd.dtype)
+        if self.encode_cache is not None:
+            # ring sized off the real context-row aval, insert/gather
+            # warmed at every bucket the dispatch path can use — part of
+            # the same pre-ready warmup, so steady state never compiles
+            self.encode_cache.ensure_store(
+                self.ctx_row_shape, self.ctx_row_dtype,
+                min_rows=max(self.buckets),
+            )
+            self.encode_cache.warm(self.buckets)
         self.warm_seconds = time.perf_counter() - t0
         counters = self._tel.counters()
         self.compiles_at_ready = counters.get("jax/compiles", 0)
@@ -409,7 +459,10 @@ class ServeEngine:
         Raises ValueError on undecodable bytes (frontend maps to 400)."""
         return self.loader.load_bytes(data)
 
-    def dispatch(self, images: np.ndarray, slot: str = "incumbent", costs=None):
+    def dispatch(
+        self, images: np.ndarray, slot: str = "incumbent", costs=None,
+        keys=None,
+    ):
         """Async: padded batch [bucket,S,S,3] → BeamResult of device
         arrays.  Calls the AOT executables directly, so the only work on
         this thread is argument transfer — the device runs ahead while the
@@ -419,12 +472,23 @@ class ServeEngine:
         (optional) is the live requests' ``RequestCost`` accumulators —
         each is charged an equal share of the measured encode window
         (telemetry/metering.py; only meaningful with telemetry on, since
-        the window is only measured inside the tel-gated block)."""
+        the window is only measured inside the tel-gated block).
+        ``keys`` (one crc32c per live request, cache-on only) routes the
+        batch through the content-addressed cache: only unique misses hit
+        the encode lane — at the smallest bucket that holds them — and
+        every row is then gathered from the ring, so hit rows are the
+        exact bits their original encode produced and hit requests are
+        charged zero encode device-ms."""
         import jax
 
         variables = self.slot_variables(slot)
         decoder_params = self.slot_decoder_params(slot)
         enc_exec, beam_exec = self._compiled[images.shape[0]]
+        cache = self.encode_cache
+        if cache is not None and keys is not None:
+            return self._dispatch_cached(
+                images, slot, costs, keys, beam_exec, decoder_params
+            )
         t0 = time.perf_counter_ns()
         contexts = enc_exec(variables, jax.device_put(images))
         if self._tel.enabled:
@@ -444,6 +508,148 @@ class ServeEngine:
                 self._tel.count("serve/encode_images", len(costs))
                 self._tel.count("serve/encode_lane_slots", images.shape[0])
         return beam_exec(decoder_params, contexts)
+
+    def _dispatch_cached(
+        self, images, slot, costs, keys, beam_exec, decoder_params
+    ):
+        """Cache-routed batch dispatch: plan rows, encode unique misses
+        at the smallest bucket that holds them, insert, gather the full
+        bucket, beam.  Encode cost is attributed ONLY to the miss
+        requests (an equal split of the measured miss-lane window), so
+        hit and coalesced requests bill zero encode device-ms and the
+        attributed≈measured identity holds."""
+        import jax
+
+        cache = self.encode_cache
+        gen = self.param_fingerprint(slot)
+        plan = cache.plan([(k, gen) for k in keys])
+        bucket = images.shape[0]
+        size = self.config.image_size
+        try:
+            if plan.n_miss:
+                mb = self.pick_bucket(plan.n_miss)
+                miss_images = np.zeros(
+                    (mb, size, size, 3), self._image_dtype
+                )
+                for j, pos in enumerate(plan.miss_pos):
+                    miss_images[j] = images[pos]
+                enc_exec = self._compiled[mb][0]
+                t0 = time.perf_counter_ns()
+                lane_ctx = enc_exec(
+                    self.slot_variables(slot), jax.device_put(miss_images)
+                )
+                if self._tel.enabled:
+                    jax.block_until_ready(lane_ctx)  # sync-ok: opt-in telemetry encode timing, gated on tel.enabled
+                    dur = time.perf_counter_ns() - t0
+                    self._tel.record("serve/encode", t0, dur)
+                    self._tel.record(f"serve/encode_lane{mb}", t0, dur)
+                    miss_costs = (
+                        [costs[p] for p in plan.miss_pos] if costs else []
+                    )
+                    if miss_costs:
+                        share = dur // len(miss_costs)
+                        for cost in miss_costs:
+                            if cost is not None:
+                                cost.add_encode(share)
+                        self._tel.count(
+                            "serve/encode_images", len(miss_costs)
+                        )
+                        self._tel.count("serve/encode_lane_slots", mb)
+                cache.insert(mb, lane_ctx, plan.miss_rows)
+            t0 = time.perf_counter_ns()
+            contexts = cache.gather(bucket, plan.rows)
+            if self._tel.enabled:
+                # hit-path latency probe (the cache block's p95); its own
+                # span, NOT a BUSY_SPAN, so metering identity is untouched
+                jax.block_until_ready(contexts)  # sync-ok: opt-in telemetry gather timing, gated on tel.enabled
+                self._tel.record(
+                    "serve/cache_gather", t0, time.perf_counter_ns() - t0
+                )
+        except Exception:
+            # the plan already registered the miss keys; their rows hold
+            # garbage now, so un-plan them before propagating
+            cache.drop([(k, gen) for k in plan.miss_keys])
+            raise
+        return beam_exec(decoder_params, contexts)
+
+    def dispatch_contexts(
+        self, contexts: List[np.ndarray], slot: str = "incumbent",
+        costs=None,
+    ):
+        """Decode-tier batch dispatch: pre-encoded context grids (the
+        tier handoff) → BeamResult, skipping the encode lane entirely.
+        Grids were aval-checked at ingress, so stacking + zero-padding to
+        the bucket feeds the warmed beam executable its exact compiled
+        shape — zero encode device-ms charged, zero compiles."""
+        import jax
+
+        decoder_params = self.slot_decoder_params(slot)
+        bucket = self.pick_bucket(len(contexts))
+        beam_exec = self._compiled[bucket][1]
+        batch = np.zeros(
+            (bucket,) + tuple(self.ctx_row_shape), self.ctx_row_dtype
+        )
+        for i, ctx in enumerate(contexts):
+            batch[i] = ctx
+        self._tel.count("serve/context_dispatches")
+        self._tel.count("serve/context_images", len(contexts))
+        return beam_exec(decoder_params, jax.device_put(batch))
+
+    # -- encode tier (POST /encode) ----------------------------------------
+
+    def warm_encode_one(self) -> None:
+        """AOT-compile the width-1 encode used by ``POST /encode`` (the
+        encode tier's whole request path).  Called from server startup
+        when ``serve_tier="encode"`` so the compile lands before ready;
+        a ``both``-tier replica that never warmed it compiles lazily on
+        the first /encode instead (one compile, documented)."""
+        import jax
+
+        if self._enc_one_exec is not None:
+            return
+        config = self.config
+        size = config.image_size
+
+        def encode_fn(variables, images):
+            contexts, _ = encode(variables, config, images, train=False)
+            return contexts
+
+        images_sd = jax.ShapeDtypeStruct(
+            (1, size, size, 3), self._image_dtype
+        )
+        enc_jit = jax.jit(encode_fn)
+        ctx_sd = jax.eval_shape(enc_jit, self._variables, images_sd)
+        self._enc_one_exec = enc_jit.lower(
+            self._variables, images_sd
+        ).compile()
+        self.ctx_row_shape = tuple(int(d) for d in ctx_sd.shape[1:])
+        self.ctx_row_dtype = np.dtype(ctx_sd.dtype)
+
+    def encode_one(
+        self, image: np.ndarray, slot: str = "incumbent"
+    ) -> np.ndarray:
+        """One preprocessed image row → its ``[N, D]`` context grid on
+        the host (the /encode response body, pre-handoff-framing).
+        Serialized by a lock: /encode arrives on HTTP threads, and the
+        width-1 executable is cheap enough that queueing beats batching
+        for the stateless encode tier."""
+        import jax
+
+        with self._enc_one_lock:
+            if self._enc_one_exec is None:
+                self.warm_encode_one()
+            t0 = time.perf_counter_ns()
+            ctx = self._enc_one_exec(
+                self.slot_variables(slot), jax.device_put(image[None])
+            )
+            grid = np.asarray(ctx)[0]  # sync-ok: /encode response body — the grid must land on the host to be framed
+            if self._tel.enabled:
+                self._tel.record(
+                    "serve/encode", t0, time.perf_counter_ns() - t0
+                )
+                self._tel.count("serve/encode_images")
+                self._tel.count("serve/encode_lane_slots")
+        return grid
 
     def drain_output(self, out, n: int) -> Tuple[np.ndarray, ...]:
         """Drain the device result for the ``n`` live rows: host arrays

@@ -35,38 +35,60 @@ from typing import Dict, List, Optional
 from ..config import Config
 
 
+# the tier values a replica may advertise (config.serve_tier): "both"
+# runs the full pipeline; "encode" only answers POST /encode; "decode"
+# only seeds slots from handed-off grids (plus grid-ingress /caption)
+TIERS = ("both", "encode", "decode")
+
+
 class Endpoint:
     """One replica's address + identity, however it came to exist."""
 
-    __slots__ = ("name", "host", "port")
+    __slots__ = ("name", "host", "port", "tier")
 
-    def __init__(self, name: str, host: str, port: int) -> None:
+    def __init__(
+        self, name: str, host: str, port: int, tier: str = "both"
+    ) -> None:
         self.name = name
         self.host = host
         self.port = int(port)
+        self.tier = tier
 
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
     def __repr__(self) -> str:  # log-friendly
-        return f"Endpoint({self.name}={self.address})"
+        suffix = "" if self.tier == "both" else f"={self.tier}"
+        return f"Endpoint({self.name}={self.address}{suffix})"
 
 
 def parse_endpoints(spec: str) -> List[Endpoint]:
-    """``host:port,host:port,...`` -> named endpoints (r0, r1, ...).
+    """``host:port[,host:port=tier,...]`` -> named endpoints (r0, r1, ...).
 
-    Fail-fast on malformed entries: a router silently fronting half the
-    fleet the operator asked for is worse than not starting."""
+    The optional ``=tier`` suffix (``encode``/``decode``/``both``)
+    declares a disaggregated fleet member's role to the router before
+    the first /healthz poll confirms it.  Fail-fast on malformed
+    entries: a router silently fronting half the fleet the operator
+    asked for is worse than not starting."""
     endpoints: List[Endpoint] = []
     for i, raw in enumerate(s for s in spec.split(",") if s.strip()):
-        host, sep, port = raw.strip().rpartition(":")
+        raw = raw.strip()
+        tier = "both"
+        if "=" in raw:
+            raw, _, tier = raw.rpartition("=")
+            if tier not in TIERS:
+                raise ValueError(
+                    f"--replicas entry {raw!r}={tier!r}: tier must be "
+                    f"one of {TIERS}"
+                )
+        host, sep, port = raw.rpartition(":")
         if not sep or not host:
             raise ValueError(
-                f"--replicas entry {raw!r}: expected host:port"
+                f"--replicas entry {raw!r}: expected host:port[=tier]"
             )
         try:
-            endpoints.append(Endpoint(f"r{i}", host, int(port)))
+            endpoints.append(Endpoint(f"r{i}", host, int(port), tier=tier))
         except ValueError:
             raise ValueError(
                 f"--replicas entry {raw!r}: port must be an integer"
@@ -167,11 +189,22 @@ class LocalFleet:
         host: str = "127.0.0.1",
         base_port: Optional[int] = None,
         env: Optional[Dict[str, str]] = None,
+        tiers: Optional[List[str]] = None,
     ) -> None:
         self.config = config
         self.root = root
         self.host = host
         self.env = env
+        # per-index tier assignment for a disaggregated fleet; a
+        # respawned replica keeps its index and therefore its tier
+        if tiers is not None and len(tiers) != count:
+            raise ValueError(
+                f"tiers names {len(tiers)} replicas, fleet has {count}"
+            )
+        self.tiers: List[str] = list(tiers) if tiers else ["both"] * count
+        for tier in self.tiers:
+            if tier not in TIERS:
+                raise ValueError(f"tier {tier!r}: must be one of {TIERS}")
         self.replicas: List[ReplicaProcess] = []
         os.makedirs(root, exist_ok=True)
         ports = (
@@ -195,10 +228,12 @@ class LocalFleet:
     def _spawn(self, index: int, port: int) -> ReplicaProcess:
         workdir = os.path.join(self.root, f"replica_{index}")
         os.makedirs(workdir, exist_ok=True)
+        tier = self.tiers[index]
         cfg = self.config.replace(
             phase="serve",
             serve_host=self.host,
             serve_port=port,
+            serve_tier=tier,
             summary_dir=os.path.join(workdir, "summary"),
             telemetry_dir=os.path.join(workdir, "telemetry"),
         )
@@ -220,7 +255,10 @@ class LocalFleet:
         finally:
             log.close()  # the child holds its own descriptor
         return ReplicaProcess(
-            Endpoint(f"r{index}", self.host, port), popen, workdir, log_path
+            Endpoint(f"r{index}", self.host, port, tier=tier),
+            popen,
+            workdir,
+            log_path,
         )
 
     def respawn(self, name: str) -> ReplicaProcess:

@@ -64,6 +64,7 @@ from ..telemetry import promtext, tracectx
 from ..telemetry import run_id as _run_id
 from ..telemetry.exporters import rotating_append
 from ..telemetry.fleet import straggler_verdict
+from .handoff import GRID_CONTENT_TYPE
 from .replica import Endpoint, LocalFleet, parse_endpoints, probe_health
 from .tenants import TenantRegistry
 
@@ -115,6 +116,13 @@ def pick_replica(
     return best
 
 
+def tier_capable(tier: Optional[str], need: str) -> bool:
+    """Whether a replica advertising ``tier`` can serve a ``need``
+    (``encode``/``decode``) hop.  An unknown/None tier is treated as
+    ``both`` — pre-tier replicas keep routing exactly as before."""
+    return tier in (need, "both", None)
+
+
 def merge_fleet(
     snapshots: Dict[str, Dict[str, Any]],
     drain_state: Dict[str, str],
@@ -126,7 +134,9 @@ def merge_fleet(
     here).  A replica is routable when it answered its last poll, calls
     itself ready, and is in rotation (not draining/drained); the
     straggler ruling runs over routable replicas' request p99s with the
-    train-plane rule."""
+    train-plane rule.  ``routable_encode``/``routable_decode`` carve the
+    routable set by advertised tier for disaggregated fleets (a
+    ``both`` replica appears in both)."""
     p99s = {
         name: snap["p99_ms"]
         for name, snap in snapshots.items()
@@ -138,6 +148,8 @@ def merge_fleet(
     ruling = straggler_verdict(p99s, straggler_factor)
     replicas: Dict[str, Dict[str, Any]] = {}
     routable: List[str] = []
+    routable_encode: List[str] = []
+    routable_decode: List[str] = []
     p50s: List[float] = []
     for name, snap in snapshots.items():
         state = drain_state.get(name, "in")
@@ -170,11 +182,17 @@ def merge_fleet(
         replicas[name] = entry
         if is_routable:
             routable.append(name)
+            if tier_capable(snap.get("tier"), "encode"):
+                routable_encode.append(name)
+            if tier_capable(snap.get("tier"), "decode"):
+                routable_decode.append(name)
             if snap.get("p50_ms") is not None:
                 p50s.append(snap["p50_ms"])
     return {
         "replicas": replicas,
         "routable": routable,
+        "routable_encode": routable_encode,
+        "routable_decode": routable_decode,
         "straggler": ruling,
         "fleet_p50_ms": (
             round(float(np.median(p50s)), 3) if p50s else None  # sync-ok: host JSON scalars
@@ -278,6 +296,7 @@ def _empty_snapshot() -> Dict[str, Any]:
         "ready": False,
         "status": "unknown",
         "degraded": False,
+        "tier": None,
         "queue_depth": 0,
         "in_flight": 0,
         "serve_mode": None,
@@ -303,14 +322,18 @@ class _ConnPool:
         self._lock = threading.Lock()
         self.connects = 0
 
-    def checkout(self) -> http.client.HTTPConnection:
+    def checkout(self) -> Tuple[http.client.HTTPConnection, bool]:
+        """Returns ``(conn, reused)``: a reused idle connection may be a
+        stale keep-alive whose peer died since checkin — a socket-level
+        failure on its first use is retryable on a fresh connection, a
+        failure on a brand-new socket is the replica actually down."""
         with self._lock:
             if self._idle:
-                return self._idle.pop()
+                return self._idle.pop(), True
             self.connects += 1
         return http.client.HTTPConnection(
             self.endpoint.host, self.endpoint.port, timeout=self.timeout_s
-        )
+        ), False
 
     def checkin(self, conn: http.client.HTTPConnection) -> None:
         with self._lock:
@@ -447,8 +470,12 @@ class Router:
             e.name: _ConnPool(e, timeout_s) for e in endpoints
         }
         self._snap_lock = threading.Lock()
+        # seed each snapshot with the endpoint's declared tier so tier
+        # routing is right from the first request even before /healthz
+        # confirms (the poll overwrites with the replica's own answer)
         self._snapshots: Dict[str, Dict[str, Any]] = {
-            name: _empty_snapshot() for name in self.endpoints
+            name: dict(_empty_snapshot(), tier=e.tier)
+            for name, e in self.endpoints.items()
         }
         self._drain_lock = threading.Lock()
         self._drain_state: Dict[str, str] = {
@@ -531,6 +558,7 @@ class Router:
                     queue_depth=int(health.get("queue_depth", 0) or 0),
                     in_flight=int(health.get("in_flight", 0) or 0),
                     serve_mode=health.get("serve_mode"),
+                    tier=health.get("tier") or endpoint.tier,
                     failures=0,
                 )
                 if with_stats:
@@ -644,16 +672,25 @@ class Router:
     # -- picks + proxy (HTTP worker threads) -------------------------------
 
     def _loads(
-        self, view: Dict[str, Any], exclude: Optional[str] = None
+        self,
+        view: Dict[str, Any],
+        exclude: Optional[str] = None,
+        tier: Optional[str] = None,
     ) -> Dict[str, float]:
         """Per-replica effective load for a pick: the polled view's
         (queue + in_flight + 1)/weight PLUS our own outstanding proxied
         requests scaled the same way, so picks balance within a poll
-        interval instead of herding on the stale snapshot."""
+        interval instead of herding on the stale snapshot.  ``tier``
+        restricts candidates to the encode-/decode-capable subset."""
         with self._pick_lock:
             outstanding = dict(self._outstanding)
+        candidates = (
+            view["routable"]
+            if tier is None
+            else view.get(f"routable_{tier}", view["routable"])
+        )
         loads = {}
-        for name in view["routable"]:
+        for name in candidates:
             if name == exclude:
                 continue
             entry = view["replicas"][name]
@@ -669,9 +706,13 @@ class Router:
                 0, self._outstanding.get(name, 0) + delta
             )
 
-    def pick(self, exclude: Optional[str] = None) -> Optional[str]:
+    def pick(
+        self,
+        exclude: Optional[str] = None,
+        tier: Optional[str] = None,
+    ) -> Optional[str]:
         view = self.view()
-        loads = self._loads(view, exclude=exclude)
+        loads = self._loads(view, exclude=exclude, tier=tier)
         with self._pick_lock:
             # a retry pick is load-greedy (no stickiness): the sticky
             # choice is exactly the replica that just failed
@@ -712,6 +753,7 @@ class Router:
         deadline_ms: Optional[str],
         tenant: Optional[str] = None,
         model: Optional[str] = None,
+        path: str = "/caption",
     ) -> Tuple[int, bytes, str, Dict[str, str]]:
         """One upstream attempt over the keep-alive pool.  Raises
         OSError/HTTPException on socket-level failure (the retryable
@@ -728,22 +770,208 @@ class Router:
         if model:
             headers["X-Model"] = model
         pool = self._pools[name]
-        conn = pool.checkout()
-        try:
-            conn.request("POST", "/caption", body=body, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
-            ctype = resp.getheader("Content-Type") or "application/json"
-            extra = {}
-            for header in ("Retry-After", "X-Shed-Scope"):
-                value = resp.getheader(header)
-                if value:
-                    extra[header] = value
-            pool.checkin(conn)
-            return resp.status, data, ctype, extra
-        except (OSError, http.client.HTTPException):
-            pool.discard(conn)
-            raise
+        while True:
+            conn, reused = pool.checkout()
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                ctype = resp.getheader("Content-Type") or "application/json"
+                extra = {}
+                for header in ("Retry-After", "X-Shed-Scope"):
+                    value = resp.getheader(header)
+                    if value:
+                        extra[header] = value
+                pool.checkin(conn)
+                return resp.status, data, ctype, extra
+            except (OSError, http.client.HTTPException):
+                pool.discard(conn)
+                if not reused:
+                    raise
+                # stale keep-alive: the peer restarted (or dropped the
+                # idle socket) since checkin.  The request never reached
+                # a live server, so one same-replica retry on a FRESH
+                # connection is safe — and for a single-replica tier it
+                # is the only retry there is.
+                self._tel.count("route/stale_conn_retries")
+
+    def _forward_attempts(
+        self,
+        path: str,
+        body: bytes,
+        rid: str,
+        content_type: Optional[str],
+        deadline_ms: Optional[str],
+        tenant: Optional[str],
+        model: Optional[str],
+        tier: Optional[str] = None,
+    ) -> Tuple[int, bytes, str, Dict[str, str], List[str], int]:
+        """One hop's pick→forward with at most one retry on a DIFFERENT
+        replica (refused/5xx/replica-shed), optionally restricted to a
+        tier-capable subset.  Returns ``(status, body, ctype, headers,
+        attempts, upstream_ns)``; status 0 means no replica answered."""
+        upstream_ns = 0
+        attempts: List[str] = []
+        status, data, ctype, extra = 0, b"", "application/json", {}
+        first = self.pick(tier=tier)
+        for name in (first, None):
+            if name is None:  # retry pick, different replica
+                name = self.pick(
+                    exclude=attempts[0] if attempts else None, tier=tier
+                )
+                if name is None or name in attempts:
+                    break
+                self._tel.count("route/retries")
+            attempts.append(name)
+            tu0 = time.perf_counter_ns()
+            self._note_outstanding(name, +1)
+            try:
+                status, data, ctype, extra = self._forward(
+                    name, body, rid, content_type, deadline_ms,
+                    tenant=tenant, model=model, path=path,
+                )
+            except (OSError, http.client.HTTPException):
+                self._tel.count("route/upstream_errors")
+                self._mark_unreachable(name)
+                status, data = 0, b""
+                continue  # connection-level failure: try the other one
+            finally:
+                self._note_outstanding(name, -1)
+                upstream_ns += time.perf_counter_ns() - tu0
+            if status >= 500 or status in _RETRYABLE:
+                self._tel.count("route/upstream_5xx" if status >= 500
+                                else "route/upstream_sheds")
+                if status == 429 and extra.get("X-Shed-Scope") == "tenant":
+                    # a tenant-quota 429 is about the TENANT, not the
+                    # replica: another replica enforces the same quota,
+                    # so the retry would only double-charge the bucket
+                    break
+                continue
+            break
+        return status, data, ctype, extra, attempts, upstream_ns
+
+    def _proxy_disagg(
+        self,
+        t0: int,
+        body: bytes,
+        rid: str,
+        content_type: Optional[str],
+        deadline_ms: Optional[str],
+        tenant: Optional[str],
+        model: Optional[str],
+        tname: Optional[str],
+    ) -> Tuple[int, bytes, str, Dict[str, str]]:
+        """Disaggregated image path: hop 1 picks an encode-capable
+        replica and POSTs ``/encode`` (image → grid frame); hop 2 picks
+        a decode-capable replica and POSTs the grid to ``/caption``.
+        Each hop gets the standard one-retry-different-replica; a
+        missing tier sheds a 429 (capacity will return — the chaos
+        campaign asserts this path mints no 5xx) instead of a 502."""
+        view = self.view()
+        if not view["routable_encode"]:
+            return self._shed_tier(t0, rid, "encode")
+        if not view["routable_decode"]:
+            return self._shed_tier(t0, rid, "decode")
+        self._tel.count("route/handoffs")
+        e_status, e_data, e_ctype, e_extra, e_attempts, e_ns = (
+            self._forward_attempts(
+                "/encode", body, rid, content_type, deadline_ms,
+                tenant, model, tier="encode",
+            )
+        )
+        if e_status == 0:
+            return self._finish(
+                t0, rid, 502, e_attempts[-1] if e_attempts else None, e_ns,
+                json.dumps(
+                    {
+                        "error": "no encode replica answered",
+                        "request_id": rid,
+                        "attempted": e_attempts,
+                    }
+                ).encode(),
+                "application/json",
+                {"Retry-After": str(self._fleet_retry_after_s())},
+            )
+        if e_status == 429:
+            if e_extra.get("X-Shed-Scope") == "tenant":
+                if tname is not None:
+                    self._tel.count(f"route/tenant_{tname}_shed")
+                return self._finish(
+                    t0, rid, e_status, e_attempts[-1], e_ns, e_data,
+                    e_ctype, e_extra,
+                )
+            return self._shed(t0, rid, replica=e_attempts[-1],
+                              upstream_ns=e_ns)
+        if e_status != 200:
+            # encode replica's own verdict (e.g. 400 bad image): pass it
+            # through — the decode hop can't fix a bad input
+            return self._finish(
+                t0, rid, e_status, e_attempts[-1], e_ns, e_data, e_ctype,
+                e_extra, retried=len(e_attempts) > 1,
+            )
+        d_status, d_data, d_ctype, d_extra, d_attempts, d_ns = (
+            self._forward_attempts(
+                "/caption", e_data, rid, GRID_CONTENT_TYPE, deadline_ms,
+                tenant, model, tier="decode",
+            )
+        )
+        upstream_ns = e_ns + d_ns
+        attempts = e_attempts + d_attempts
+        if d_status == 0:
+            return self._finish(
+                t0, rid, 502, d_attempts[-1] if d_attempts else None,
+                upstream_ns,
+                json.dumps(
+                    {
+                        "error": "no decode replica answered",
+                        "request_id": rid,
+                        "attempted": attempts,
+                    }
+                ).encode(),
+                "application/json",
+                {"Retry-After": str(self._fleet_retry_after_s())},
+            )
+        if d_status == 429:
+            if d_extra.get("X-Shed-Scope") == "tenant":
+                if tname is not None:
+                    self._tel.count(f"route/tenant_{tname}_shed")
+                return self._finish(
+                    t0, rid, d_status, d_attempts[-1], upstream_ns, d_data,
+                    d_ctype, d_extra,
+                )
+            return self._shed(t0, rid, replica=d_attempts[-1],
+                              upstream_ns=upstream_ns)
+        headers = dict(d_extra)
+        if e_attempts:
+            headers["X-Routed-Encode-Replica"] = e_attempts[-1]
+        return self._finish(
+            t0, rid, d_status, d_attempts[-1], upstream_ns, d_data,
+            d_ctype, headers, retried=len(attempts) > 2,
+        )
+
+    def _shed_tier(
+        self, t0: int, rid: str, tier: str
+    ) -> Tuple[int, bytes, str, Dict[str, str]]:
+        """Tier-starved shed: the fleet is up but no routable replica
+        can run this hop (e.g. the encode tier is mid-respawn).  A 429
+        with the fleet hint — capacity returns on the respawn, so
+        clients back off rather than fail over a 5xx."""
+        self._tel.count("route/sheds")
+        self._tel.count(f"route/tier_{tier}_starved")
+        secs = self._fleet_retry_after_s()
+        body = json.dumps(
+            {
+                "error": f"no routable {tier}-capable replica; retry later",
+                "retry_after_ms": secs * 1000,
+                "shed_scope": "tier",
+                "tier": tier,
+                "request_id": rid,
+            }
+        ).encode()
+        return self._finish(
+            t0, rid, 429, None, 0, body, "application/json",
+            {"Retry-After": str(secs), "X-Shed-Scope": "tier"},
+        )
 
     def proxy_caption(
         self,
@@ -791,42 +1019,31 @@ class Router:
             # proactive edge shed: every replica's queue is already at
             # the configured depth — one coherent 429, no forwarding
             return self._shed(t0, rid)
-        first = self.pick()
-        upstream_ns = 0
-        attempts: List[str] = []
-        status, data, ctype, extra = 0, b"", "application/json", {}
-        for attempt, name in enumerate((first, None)):
-            if name is None:  # retry pick, different replica
-                name = self.pick(exclude=attempts[0])
-                if name is None:
-                    break
-                self._tel.count("route/retries")
-            attempts.append(name)
-            tu0 = time.perf_counter_ns()
-            self._note_outstanding(name, +1)
-            try:
-                status, data, ctype, extra = self._forward(
-                    name, body, rid, content_type, deadline_ms,
-                    tenant=tenant, model=model,
-                )
-            except (OSError, http.client.HTTPException):
-                self._tel.count("route/upstream_errors")
-                self._mark_unreachable(name)
-                status, data = 0, b""
-                continue  # connection-level failure: try the other one
-            finally:
-                self._note_outstanding(name, -1)
-                upstream_ns += time.perf_counter_ns() - tu0
-            if status >= 500 or status in _RETRYABLE:
-                self._tel.count("route/upstream_5xx" if status >= 500
-                                else "route/upstream_sheds")
-                if status == 429 and extra.get("X-Shed-Scope") == "tenant":
-                    # a tenant-quota 429 is about the TENANT, not the
-                    # replica: another replica enforces the same quota,
-                    # so the retry would only double-charge the bucket
-                    break
-                continue
-            break
+        # tiered fleet? image requests go two-hop (encode tier mints the
+        # grid, decode tier captions it); grid-carrying requests — from
+        # a client or our own second hop — go straight to decode
+        base_ctype = (content_type or "").split(";", 1)[0].strip()
+        grid_in = base_ctype == GRID_CONTENT_TYPE
+        tiered = len(view["routable_encode"]) != len(view["routable"]) or (
+            len(view["routable_decode"]) != len(view["routable"])
+        )
+        if grid_in:
+            if not view["routable_decode"]:
+                return self._shed_tier(t0, rid, "decode")
+            hop_tier: Optional[str] = "decode" if tiered else None
+        elif tiered:
+            return self._proxy_disagg(
+                t0, body, rid, content_type, deadline_ms, tenant, model,
+                tname,
+            )
+        else:
+            hop_tier = None
+        status, data, ctype, extra, attempts, upstream_ns = (
+            self._forward_attempts(
+                "/caption", body, rid, content_type, deadline_ms,
+                tenant, model, tier=hop_tier,
+            )
+        )
         if status == 0:
             # both attempts (or the only routable replica) refused
             return self._finish(
@@ -1088,6 +1305,8 @@ class Router:
             "uptime_s": round(time.time() - self._t_start, 1),
             "replicas_routable": len(routable),
             "replicas_total": total,
+            "replicas_encode": len(view["routable_encode"]),
+            "replicas_decode": len(view["routable_decode"]),
             # same top-level load signals a stacked router would poll
             "queue_depth": view["queue_depth"],
             "in_flight": view["in_flight"],
@@ -1135,6 +1354,8 @@ class Router:
             "ready": bool(view["routable"]),
             "replicas": view["replicas"],
             "routable": view["routable"],
+            "routable_encode": view["routable_encode"],
+            "routable_decode": view["routable_decode"],
             "straggler": view["straggler"],
             "fleet_p50_ms": view["fleet_p50_ms"],
             "queue_depth": view["queue_depth"],
@@ -1163,6 +1384,12 @@ class Router:
     def metrics_text(self) -> str:
         view = self.view()
         self._tel.gauge("route/replicas_routable", len(view["routable"]))
+        self._tel.gauge(
+            "route/replicas_encode", len(view["routable_encode"])
+        )
+        self._tel.gauge(
+            "route/replicas_decode", len(view["routable_decode"])
+        )
         self._tel.gauge("route/fleet_queue_depth", view["queue_depth"])
         self._tel.gauge("route/fleet_in_flight", view["in_flight"])
         self._tel.gauge(

@@ -74,6 +74,7 @@ from ..telemetry.profwin import ProfileLatch
 from ..telemetry.quality import QualityMonitor, QualityReference
 from ..telemetry.slo import SLOEngine, objectives_from_config
 from ..utils.summary import crc32c
+from . import handoff
 from .batcher import ContinuousBatcher, MicroBatcher, Rejected
 from .engine import ServeEngine, load_serving_state
 from .slot_pool import PagedSlotPool
@@ -236,7 +237,7 @@ class _Handler(BaseHTTPRequestHandler):
                 status = 409 if "in progress" in info else 503
                 self._reply(status, {"error": info}, rid)
             return
-        if route != "/caption":
+        if route not in ("/caption", "/encode"):
             self._reply(404, {"error": f"no route {self.path}"}, rid)
             return
         try:
@@ -247,12 +248,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": "empty body; POST image bytes"}, rid)
             return
         body = self.rfile.read(length)
+        if route == "/encode":
+            # encode tier: image bytes in, framed context grid out
+            status, out_body, ctype = app.handle_encode(body)
+            self._send(status, out_body, ctype, rid)
+            return
         status, payload = app.handle_caption(
             body,
             deadline_ms=self.headers.get("X-Deadline-Ms"),
             request_id=rid,
             tenant=self.headers.get("X-Tenant"),
             model=self.headers.get("X-Model"),
+            content_type=self.headers.get("Content-Type"),
         )
         headers = None
         if status in (429, 503) and "retry_after_ms" in payload:
@@ -435,6 +442,11 @@ class CaptionServer:
                     )
                 ),
                 sketch=self._cache_sketch,
+                # the REAL cache (when --encode_cache on): its measured
+                # hit ratio publishes next to the sketch's prediction
+                # plus the reconciliation delta
+                # getattr: engine doubles in tests don't grow the attr
+                cache=getattr(engine, "encode_cache", None),
             )
         self.slo = SLOEngine(
             self._tel,
@@ -570,9 +582,49 @@ class CaptionServer:
         payload["request_id"] = trace.trace_id
         return status, payload
 
+    def handle_encode(self, body: bytes) -> Tuple[int, bytes, str]:
+        """``POST /encode`` (the encode tier's request path): JPEG/PNG
+        bytes → a framed context grid (serve/handoff.py) a decode-tier
+        replica accepts on /caption.  Stateless per request — no slot,
+        no queue — so the encode tier scales on batch-friendly replicas
+        with zero decode state."""
+        t0 = time.perf_counter_ns()
+
+        def _err(status: int, payload: Dict[str, Any]):
+            return status, json.dumps(payload).encode(), "application/json"
+
+        if not self._ready:
+            return _err(
+                503, {"error": "server is draining; not accepting work"}
+            )
+        try:
+            with self._tel.span("serve/preprocess"):
+                image = self.engine.preprocess(body)
+        except Exception as e:
+            self._tel.count("serve/bad_input")
+            return _err(
+                400,
+                {"error": "bad image",
+                 "detail": f"cannot decode image bytes: {e}"},
+            )
+        try:
+            grid = self.engine.encode_one(image)
+        except Exception as e:
+            self._tel.count("serve/encode_http_errors")
+            return _err(500, {"error": f"encode failed: {e}"})
+        self._tel.count("serve/encode_http")
+        self._tel.record(
+            "serve/encode_request", t0, time.perf_counter_ns() - t0
+        )
+        return (
+            200,
+            handoff.encode_grid(grid, step=self.engine.step),
+            handoff.GRID_CONTENT_TYPE,
+        )
+
     def handle_caption(
         self, body: bytes, deadline_ms=None, request_id=None,
-        tenant=None, model=None,
+        tenant=None, model=None, content_type=None,
     ) -> Tuple[int, Dict[str, Any]]:
         t_req0 = time.perf_counter_ns()
         trace = self.tracer.begin(request_id)
@@ -620,28 +672,79 @@ class CaptionServer:
                 },
                 tenant=tname,
             )
-        try:
-            with self._tel.span("serve/preprocess"):
-                image = self.engine.preprocess(body)
-        except Exception as e:
-            # undecodable POST body: a client problem, not a server crash —
-            # counted so a flood of garbage uploads shows in the heartbeat
-            self._tel.count("serve/bad_input")
-            return self._finish_request(
-                trace,
-                400,
-                {
-                    "error": "bad image",
-                    "detail": f"cannot decode image bytes: {e}",
-                },
-                tenant=tname,
-            )
-        if self._cache_sketch is not None:
-            # would-be encode-cache probe (telemetry/capacity.py): hash
-            # the raw POST bytes (no pixels retained) and ask whether a
-            # bounded cache would have hit — the live Zipf evidence for
-            # the encode-cache split (ROADMAP item 2)
-            self._cache_sketch.observe(crc32c(body))
+        image = None
+        context = None
+        key = None
+        base_ctype = (content_type or "").split(";", 1)[0].strip()
+        if base_ctype == handoff.GRID_CONTENT_TYPE:
+            # decode-tier ingress: the body is a pre-encoded context grid
+            # from an encode-tier replica (serve/handoff.py) — verify the
+            # frame (crc32c sidecar) and the aval against OUR warmed
+            # executables before any device work
+            try:
+                grid, header = handoff.decode_grid(body)
+                if self.engine.ctx_row_shape is None:
+                    raise handoff.HandoffError(
+                        "replica has no warmed context aval yet"
+                    )
+                handoff.check_aval(
+                    grid, self.engine.ctx_row_shape,
+                    self.engine.ctx_row_dtype,
+                )
+            except handoff.HandoffError as e:
+                self._tel.count("serve/bad_handoff")
+                return self._finish_request(
+                    trace, 400,
+                    {"error": "bad grid", "detail": str(e)},
+                    tenant=tname,
+                )
+            gstep = header.get("step")
+            if gstep is not None and int(gstep) != self.engine.step:
+                # cross-generation handoff: the encoder ran a different
+                # promote generation than this decoder — decoding it
+                # would caption with mismatched params
+                self._tel.count("serve/stale_handoff")
+                return self._finish_request(
+                    trace, 409,
+                    {
+                        "error": (
+                            f"grid encoded at model step {gstep}; this "
+                            f"replica serves step {self.engine.step}"
+                        ),
+                    },
+                    tenant=tname,
+                )
+            context = grid
+            self._tel.count("serve/grid_requests")
+        else:
+            try:
+                with self._tel.span("serve/preprocess"):
+                    image = self.engine.preprocess(body)
+            except Exception as e:
+                # undecodable POST body: a client problem, not a server
+                # crash — counted so a flood of garbage uploads shows in
+                # the heartbeat
+                self._tel.count("serve/bad_input")
+                return self._finish_request(
+                    trace,
+                    400,
+                    {
+                        "error": "bad image",
+                        "detail": f"cannot decode image bytes: {e}",
+                    },
+                    tenant=tname,
+                )
+            if self._cache_sketch is not None:
+                # would-be encode-cache probe (telemetry/capacity.py):
+                # hash the raw POST bytes (no pixels retained) and ask
+                # whether a bounded cache would have hit — the live Zipf
+                # evidence the real cache below now reconciles against
+                self._cache_sketch.observe(crc32c(body))
+            if getattr(self.engine, "encode_cache", None) is not None:
+                # content address for the REAL cache: the preprocessed
+                # pixels (two byte-identical uploads of one image hash
+                # equal here even if their container bytes differ)
+                key = crc32c(image.tobytes())
         if deadline_ms is None or deadline_ms == "":
             budget_ms = self.config.serve_deadline_ms
         else:
@@ -680,7 +783,7 @@ class CaptionServer:
         try:
             req = self.batcher.submit(
                 image, deadline_unix=deadline_unix, trace=trace, slot=slot,
-                tenant=spec.name, raw=body,
+                tenant=spec.name, raw=body, key=key, context=context,
             )
         except Rejected as e:
             # shed exemplar: a rate-limited sample of refused requests
@@ -749,13 +852,15 @@ class CaptionServer:
             # shadow sampling: during a canary window, a sample of
             # incumbent answers is replayed against the candidate to
             # feed the caption-divergence gauge (bounded queue, never
-            # blocks this handler thread)
-            try:
-                self.lifecycle.maybe_shadow(
-                    image, payload["captions"][0]["caption"]
-                )
-            except (KeyError, IndexError, TypeError):
-                pass
+            # blocks this handler thread).  Grid-ingress requests carry
+            # no image to replay, so they never shadow.
+            if image is not None:
+                try:
+                    self.lifecycle.maybe_shadow(
+                        image, payload["captions"][0]["caption"]
+                    )
+                except (KeyError, IndexError, TypeError):
+                    pass
         return self._finish_request(
             trace, 200, payload, bucket=req.bucket, slot=slot, tenant=tname,
             cost=req.cost,
@@ -839,6 +944,10 @@ class CaptionServer:
                 "queue_depth": self.batcher.queue_depth(),
                 "in_flight": self.in_flight,
                 "serve_mode": self.config.serve_mode,
+                # fleet tier (encode/decode/both): the router's poller
+                # routes image traffic to encode-capable replicas and
+                # grid handoffs to decode-capable ones off this field
+                "tier": self.config.serve_tier,
                 "buckets": list(self.engine.buckets),
                 "model_step": self.engine.step,
                 # lifecycle plane: balancers and the fleet router see a
@@ -932,6 +1041,7 @@ class CaptionServer:
         out = {
             "ready": self._ready,
             "serve_mode": self.config.serve_mode,
+            "tier": self.config.serve_tier,
             "queue_depth": self.batcher.queue_depth(),
             "in_flight": self.in_flight,
             "buckets": list(self.engine.buckets),
@@ -986,6 +1096,15 @@ class CaptionServer:
                 "page_width": self.pool.width,
                 "busy": self.pool.occupancy(),
             }
+        if getattr(self.engine, "encode_cache", None) is not None:
+            # the cache block: host LRU state + lifetime counters, plus
+            # the hit path's own device latency (gather) so operators see
+            # what a hit actually costs vs the encode it skipped
+            cache_block = self.engine.encode_cache.stats()
+            gp = _percentiles_ms(self._tel, "serve/cache_gather")
+            if gp:
+                cache_block["gather_ms"] = gp
+            out["encode_cache"] = cache_block
         if self.tenants.multi:
             out["tenants"] = self._tenant_block(counters)
         if self.metering is not None:
@@ -1088,10 +1207,20 @@ class CaptionServer:
             # time (the tenant dimension rides the metric name, so
             # promtext exports them with no label machinery)
             self._tenant_block(self._tel.counters())
+        if getattr(self.engine, "encode_cache", None) is not None:
+            # scrape-time refresh of the cache residency gauges (the
+            # counters tick live; entries/bytes are host-map reads)
+            cstats = self.engine.encode_cache.stats()
+            self._tel.gauge("serve/cache_entries", cstats["entries"])
+            self._tel.gauge("serve/cache_bytes", cstats["bytes"])
+            self._tel.gauge("serve/cache_hit_ratio", cstats["hit_ratio"])
+            gp = _percentiles_ms(self._tel, "serve/cache_gather")
+            if gp:
+                self._tel.gauge("serve/cache_gather_ms_p95", gp["p95"])
         if self.capacity is not None:
             # scrape-time refresh of the capacity/* gauges (headroom,
-            # ceiling, lane fill, would-hit ratio) — rate-limited, so an
-            # aggressive scraper costs one clock read per scrape
+            # ceiling, lane fill, would-hit + actual hit ratios) —
+            # rate-limited, so an aggressive scraper costs one clock read
             self.capacity.maybe_update()
         if self.quality is not None:
             # scrape-time refresh of the quality/* gauges (per-signal
@@ -1189,6 +1318,16 @@ class CaptionServer:
                 interval_s=max(0.1, min(5.0, self.config.slo_window_fast_s / 4))
             )
         self.lifecycle.start()
+        if self.config.serve_tier == "encode":
+            # an encode-tier replica's whole request path is POST /encode:
+            # warm its width-1 executable before ready so the first
+            # request never compiles, and extend the zero-recompile
+            # ledger past it (same bookkeeping as the pool warmup)
+            self.engine.warm_encode_one()
+            self.engine.compiles_at_ready = max(
+                self.engine.compiles_at_ready,
+                self._tel.counters().get("jax/compiles", 0),
+            )
         self._ready = True
         self._tel.gauge("serve/ready", 1)
         return self

@@ -171,6 +171,19 @@ class PagedSlotPool:
                 engine._decoder_params, config, carry_sd, ctx_sd,
                 src_sd, mask_sd, beam_size=K,
             ).compile()
+        # the decode tier validates handoff grids against this aval, and
+        # context-seeded admissions stack into it
+        engine.ctx_row_shape = tuple(int(d) for d in ctx_sd.shape[1:])
+        engine.ctx_row_dtype = np.dtype(ctx_sd.dtype)
+        cache = getattr(engine, "encode_cache", None)
+        if cache is not None:
+            # ring geometry + insert/gather executables for every
+            # admission lane, warmed pre-ready like everything else here
+            cache.ensure_store(
+                engine.ctx_row_shape, engine.ctx_row_dtype,
+                min_rows=max(self.lane_widths),
+            )
+            cache.warm(self.lane_widths)
         # ONE decode executable serves every depth: the fused window takes
         # the depth as a runtime operand, so step() is just depth 1 of the
         # same program — compiling a separate single-step lane would double
@@ -278,53 +291,54 @@ class PagedSlotPool:
         import jax
 
         admitted = 0
-        size = self.config.image_size
         free = sorted(self._free)
+        cache = getattr(self.engine, "encode_cache", None)
+
+        def _is_ctx(item) -> bool:
+            # a payload carrying a pre-encoded grid (the tier handoff)
+            # seeds from it directly; bulk's int payloads simply say no
+            return getattr(item[1], "context", None) is not None
+
         while admitted < len(items) and free:
-            chunk = min(len(items) - admitted, len(free), self.width)
+            # a chunk is a run of same-kind items (image vs pre-encoded
+            # context): the two kinds reach the seed exec through
+            # different sources, but the seed itself is shared
+            is_ctx = _is_ctx(items[admitted])
+            run = 1
+            while (
+                admitted + run < len(items)
+                and _is_ctx(items[admitted + run]) == is_ctx
+            ):
+                run += 1
+            chunk = min(run, len(free), self.width)
             lane = next(w for w in self.lane_widths if w >= chunk)
-            images = np.zeros(
-                (lane, size, size, 3), self.engine._image_dtype
-            )
             slot_src = np.zeros((self.slots,), np.int32)
             admit_mask = np.zeros((self.slots,), np.bool_)
             chunk_payloads = []
+            chunk_rows = []
             for j in range(chunk):
                 image, payload = items[admitted]
                 admitted += 1
                 s = free.pop(0)
-                images[j] = image
+                chunk_rows.append(
+                    payload.context if is_ctx else image
+                )
                 slot_src[s] = j
                 admit_mask[s] = True
                 self._free.discard(s)
                 self._payload[s] = payload
                 self._mask[s] = True
                 chunk_payloads.append(payload)
-            t0 = time.perf_counter_ns()
-            contexts = self._enc_execs[lane](
-                self.engine.slot_variables(self.param_slot),
-                jax.device_put(images),
-            )
-            if self._tel.enabled:
-                # per-lane encode timing (serve/encode_ms introspection):
-                # the seed exec consumes the contexts immediately, so with
-                # telemetry on we wait the encode out here; with telemetry
-                # off the admission path stays fully async
-                jax.block_until_ready(contexts)  # sync-ok: opt-in telemetry encode timing, gated on tel.enabled
-                dur = time.perf_counter_ns() - t0
-                self._tel.record("serve/encode", t0, dur)
-                self._tel.record(f"serve/encode_lane{lane}", t0, dur)
-                # cost attribution (telemetry/metering.py): each request
-                # in the chunk is charged an equal share of this lane's
-                # measured window; padded lane slots bill nobody but feed
-                # the encode-lane-fill capacity gauge
-                share = dur // chunk
-                for payload in chunk_payloads:
-                    cost = getattr(payload, "cost", None)
-                    if cost is not None:
-                        cost.add_encode(share)
-                self._tel.count("serve/encode_images", chunk)
-                self._tel.count("serve/encode_lane_slots", lane)
+            if is_ctx:
+                contexts = self._ctx_lane(lane, chunk_rows)
+            elif cache is not None:
+                contexts = self._encode_lane_cached(
+                    lane, chunk_rows, chunk_payloads
+                )
+            else:
+                contexts = self._encode_lane(
+                    lane, chunk, chunk_rows, chunk_payloads
+                )
             self._carry = self._seed_execs[lane](
                 self.engine.slot_decoder_params(self.param_slot),
                 self._carry,
@@ -334,6 +348,127 @@ class PagedSlotPool:
             )
         self._tel.gauge(self._occ_gauge, self.occupancy())
         return admitted
+
+    def _encode_lane(self, lane, chunk, chunk_rows, chunk_payloads):
+        """The pre-cache encode lane, byte-for-byte: stack, encode at the
+        lane width, attribute the measured window (--encode_cache off
+        takes exactly this path, the bit-identity knob pins it)."""
+        import jax
+
+        size = self.config.image_size
+        images = np.zeros((lane, size, size, 3), self.engine._image_dtype)
+        for j, row in enumerate(chunk_rows):
+            images[j] = row
+        t0 = time.perf_counter_ns()
+        contexts = self._enc_execs[lane](
+            self.engine.slot_variables(self.param_slot),
+            jax.device_put(images),
+        )
+        if self._tel.enabled:
+            # per-lane encode timing (serve/encode_ms introspection):
+            # the seed exec consumes the contexts immediately, so with
+            # telemetry on we wait the encode out here; with telemetry
+            # off the admission path stays fully async
+            jax.block_until_ready(contexts)  # sync-ok: opt-in telemetry encode timing, gated on tel.enabled
+            dur = time.perf_counter_ns() - t0
+            self._tel.record("serve/encode", t0, dur)
+            self._tel.record(f"serve/encode_lane{lane}", t0, dur)
+            # cost attribution (telemetry/metering.py): each request
+            # in the chunk is charged an equal share of this lane's
+            # measured window; padded lane slots bill nobody but feed
+            # the encode-lane-fill capacity gauge
+            share = dur // chunk
+            for payload in chunk_payloads:
+                cost = getattr(payload, "cost", None)
+                if cost is not None:
+                    cost.add_encode(share)
+            self._tel.count("serve/encode_images", chunk)
+            self._tel.count("serve/encode_lane_slots", lane)
+        return contexts
+
+    def _encode_lane_cached(self, lane, chunk_rows, chunk_payloads):
+        """Cache-routed admission lane: plan ring rows for the chunk's
+        content keys, encode only the unique misses (at the smallest
+        lane that holds them), insert, then gather the whole chunk from
+        the ring.  Hit rows are the exact bits their original encode
+        produced; hit/coalesced requests are charged zero encode
+        device-ms — only the miss requests split the measured window."""
+        import jax
+
+        from ..utils.summary import crc32c
+
+        engine = self.engine
+        cache = getattr(engine, "encode_cache", None)
+        size = self.config.image_size
+        gen = engine.param_fingerprint(self.param_slot)
+        keys = []
+        for row, payload in zip(chunk_rows, chunk_payloads):
+            key = getattr(payload, "key", None)
+            if key is None:
+                # bulk / direct-admit payloads carry no precomputed key;
+                # hash the preprocessed row here (same digest the server
+                # stamps on requests)
+                key = crc32c(np.ascontiguousarray(row).tobytes())
+            keys.append((key, gen))
+        plan = cache.plan(keys)
+        try:
+            if plan.n_miss:
+                enc_lane = next(
+                    w for w in self.lane_widths if w >= plan.n_miss
+                )
+                images = np.zeros(
+                    (enc_lane, size, size, 3), engine._image_dtype
+                )
+                for j, pos in enumerate(plan.miss_pos):
+                    images[j] = chunk_rows[pos]
+                t0 = time.perf_counter_ns()
+                lane_ctx = self._enc_execs[enc_lane](
+                    engine.slot_variables(self.param_slot),
+                    jax.device_put(images),
+                )
+                if self._tel.enabled:
+                    jax.block_until_ready(lane_ctx)  # sync-ok: opt-in telemetry encode timing, gated on tel.enabled
+                    dur = time.perf_counter_ns() - t0
+                    self._tel.record("serve/encode", t0, dur)
+                    self._tel.record(f"serve/encode_lane{enc_lane}", t0, dur)
+                    share = dur // plan.n_miss
+                    for pos in plan.miss_pos:
+                        cost = getattr(chunk_payloads[pos], "cost", None)
+                        if cost is not None:
+                            cost.add_encode(share)
+                    self._tel.count("serve/encode_images", plan.n_miss)
+                    self._tel.count("serve/encode_lane_slots", enc_lane)
+                cache.insert(enc_lane, lane_ctx, plan.miss_rows)
+            t0 = time.perf_counter_ns()
+            contexts = cache.gather(lane, plan.rows)
+            if self._tel.enabled:
+                # hit-path latency probe (the cache block's p95); its own
+                # span, NOT a BUSY_SPAN, so metering identity is untouched
+                jax.block_until_ready(contexts)  # sync-ok: opt-in telemetry gather timing, gated on tel.enabled
+                self._tel.record(
+                    "serve/cache_gather", t0, time.perf_counter_ns() - t0
+                )
+        except Exception:
+            # the plan registered the miss keys before the encode landed;
+            # their rows hold garbage, so un-plan them before propagating
+            cache.drop(plan.miss_keys)
+            raise
+        return contexts
+
+    def _ctx_lane(self, lane, chunk_rows):
+        """Decode-tier admission: stack pre-encoded handoff grids into
+        the lane's context shape (aval-checked at ingress) — no encode,
+        no cache, zero encode device-ms charged."""
+        import jax
+
+        engine = self.engine
+        batch = np.zeros(
+            (lane,) + tuple(engine.ctx_row_shape), engine.ctx_row_dtype
+        )
+        for j, grid in enumerate(chunk_rows):
+            batch[j] = grid
+        self._tel.count("serve/context_images", len(chunk_rows))
+        return jax.device_put(batch)
 
     def step(self):
         """One decode step over the whole pool — the fused window at

@@ -95,6 +95,7 @@ class CapacityModel(object):
         slots: int,
         interval_s: float = 1.0,
         sketch: Optional[EncodeCacheSketch] = None,
+        cache=None,
         clock=time.monotonic,
     ) -> None:
         self._tel = tel
@@ -102,6 +103,9 @@ class CapacityModel(object):
         self._slots = max(int(slots), 1)
         self._interval = float(interval_s)
         self._sketch = sketch
+        # the real EncodeCache (when --encode_cache on): its measured hit
+        # ratio closes the loop on the sketch's would-hit prediction
+        self._cache = cache
         self._clock = clock
         self._lock = threading.Lock()
         self._t_last = clock()
@@ -182,3 +186,13 @@ class CapacityModel(object):
                 "capacity/encode_cache_would_hit_ratio",
                 round(self._sketch.ratio(), 4),
             )
+        if self._cache is not None and self._cache.lookups:
+            actual = self._cache.hit_ratio()
+            tel.gauge("capacity/encode_cache_hit_ratio", round(actual, 4))
+            if self._sketch is not None and self._sketch.lookups:
+                # prediction-vs-reality residual: positive means the sketch
+                # over-promised (e.g. its window outlives the real ring)
+                tel.gauge(
+                    "capacity/encode_cache_reconcile_delta",
+                    round(self._sketch.ratio() - actual, 4),
+                )

@@ -58,7 +58,18 @@ scripts/check_regression.py:
   each fleet size — ``fleet_goodput_rps`` (req_per_s, higher is better,
   with per-size goodput/scaling extras), ``fleet_open_loop_p99_latency_ms``
   (ms, lower is better) and ``fleet_router_overhead_ms`` (the router's
-  own p50 per-request cost).
+  own p50 per-request cost).  A final disaggregated arm spawns an
+  encode-tier + decode-tier pair and runs the same load two-hop through
+  the router (``fleet_disagg_goodput_rps``, req_per_s, higher is
+  better — the feature-grid handoff priced against the n=1 arm).
+* ``--encode-cache`` switches to the content-addressed encode-cache
+  campaign (docs/SERVING.md "Encode cache & tiered fleets"): a hit/cold
+  bitwise caption-parity phase, then an all-unique control arm and a
+  Zipf repeat-traffic arm on one cache-on server —
+  ``encode_cache_hit_ratio`` (ratio, higher is better; acceptance
+  floor 0.6 on the Zipf arm, ~0 on unique) and
+  ``cache_serve_goodput_rps`` (req_per_s, higher is better).  Exit 1
+  on any recompile, any parity mismatch, or a dead/false ratio.
 * ``--metering`` switches to the cost-attribution campaign
   (docs/OBSERVABILITY.md "Cost attribution and tenant metering"):
   ``metering_overhead_pct`` (pct, lower is better: the full
@@ -510,6 +521,58 @@ def fleet_bench(args, workdir) -> int:
                 - base_compiles[e.name]
             )
         log(f"per-replica steady-state recompiles: {recompiles}")
+
+        # --- disaggregated arm: encode tier + decode tier ----------------
+        # the same open-loop load through a two-replica tiered fleet
+        # (docs/SERVING.md "Encode cache & tiered fleets"): the router
+        # two-hops every image request (/encode on the encode tier, the
+        # framed grid to /caption on the decode tier).  The service
+        # floor arms only the batcher drain, so the arm is decode-bound
+        # — goodput should track the n=1 arm (one floored decode
+        # replica) and the row prices the handoff overhead against it.
+        disagg_res = None
+        disagg = LocalFleet(
+            config, 2, root=os.path.join(workdir, "fleet_disagg"),
+            env=fleet_env, tiers=["encode", "decode"],
+        )
+        try:
+            log(f"disagg fleet (encode+decode tiers) on ports "
+                f"{[e.port for e in disagg.endpoints]}")
+            disagg.wait_ready(timeout_s=600)
+            d_base = {}
+            for e in disagg.endpoints:
+                d_base[e.name] = _get_json(e.port, "/stats")[
+                    "compiles_since_ready"
+                ]
+            router = Router(route_cfg, disagg.endpoints, port=0).start()
+            try:
+                _post(router.port, jpegs[0])  # warm both hops
+                disagg_res = open_loop(
+                    router.port, jpegs, args.fleet_rate,
+                    args.fleet_requests, timeout=150.0,
+                )
+                disagg_res["goodput"] = (
+                    disagg_res["ok"] / disagg_res["wall_s"]
+                    if disagg_res["wall_s"] else 0.0
+                )
+                stats = _get_json(router.port, "/stats")
+                disagg_res["handoffs"] = stats.get("counters", {}).get(
+                    "route/handoffs", 0
+                )
+            finally:
+                router.shutdown()
+            for e in disagg.endpoints:
+                recompiles[f"disagg_{e.name}"] = (
+                    _get_json(e.port, "/stats")["compiles_since_ready"]
+                    - d_base[e.name]
+                )
+            log(f"disagg arm @ {args.fleet_rate}/s: {disagg_res['ok']} "
+                f"ok, {disagg_res['shed']} shed, {disagg_res['errors']} "
+                f"errors -> {disagg_res['goodput']:.1f} req/s "
+                f"({disagg_res['handoffs']} handoffs, p99 "
+                f"{disagg_res['p99']}ms)")
+        finally:
+            disagg.stop_all()
     finally:
         _CLIENT.close_all()
         fleet.stop_all()
@@ -556,6 +619,22 @@ def fleet_bench(args, workdir) -> int:
         "p99_by_n": {str(n): r["p99"] for n, r in results.items()},
         **common,
     }), flush=True)
+    if disagg_res is not None:
+        print(json.dumps({
+            "metric": "fleet_disagg_goodput_rps",
+            "value": round(disagg_res["goodput"], 2),
+            "unit": "req_per_s",
+            "tiers": ["encode", "decode"],
+            "completed": disagg_res["ok"], "shed": disagg_res["shed"],
+            "errors": disagg_res["errors"],
+            "p50_ms": disagg_res["p50"], "p99_ms": disagg_res["p99"],
+            "handoffs": disagg_res["handoffs"],
+            "single_replica_goodput": (
+                round(results[min(sizes)]["goodput"], 2)
+                if min(sizes) in results else None
+            ),
+            **common,
+        }), flush=True)
     over_all = np.asarray(overhead_ns, np.float64)
     print(json.dumps({
         "metric": "fleet_router_overhead_ms",
@@ -988,6 +1067,202 @@ def metering_bench(args, workdir) -> int:
     return 0 if ok else 1
 
 
+def _post_caption(port, data, timeout=60.0):
+    """One POST /caption via urllib, returning (status, parsed JSON) —
+    the parity phases need the caption STRINGS, not just latencies."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/caption", data=data,
+        headers={"Content-Type": "image/jpeg"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, {}
+
+
+def encode_cache_bench(args, workdir) -> int:
+    """--encode-cache: the content-addressed encode cache under repeat
+    traffic (docs/SERVING.md "Encode cache & tiered fleets").
+
+    One cache-on continuous-mode server, three phases:
+
+    * **parity** — every base image captioned cold (a cache miss each),
+      then again (a hit each): the hit captions must be BITWISE equal
+      to the cold ones.  The cache stores the encoder's own output grid
+      and the decode path is shared, so ANY drift is a correctness bug
+      — exit 1 on the first mismatch.
+    * **unique control** — open loop where every arrival is a distinct
+      image: content addressing buys nothing, the hit ratio must read
+      ~0 (the cache is flushed first so the arm is self-contained).
+    * **zipf arm** — the same open loop with arrivals drawn
+      rank-weighted (p ∝ 1/(rank+1)^--zipf-s) from a small base: the
+      repeat-heavy regime the cache exists for.  The arm's
+      ``encode_cache_hit_ratio`` must clear the 0.6 acceptance floor,
+      and ``cache_serve_goodput_rps`` reports its goodput with the
+      unique arm riding as the control extra.
+
+    The ring is AOT-warmed at boot (insert + gather per lane width), so
+    every phase also asserts ZERO steady-state recompiles — one XLA
+    compile under load exits 1."""
+    from sat_tpu import telemetry
+    from sat_tpu.serve.engine import ServeEngine, load_serving_state
+    from sat_tpu.serve.server import CaptionServer
+
+    config, vocabulary, tel = _make_ckpt(args, workdir)
+    config = config.replace(
+        serve_mode="continuous",
+        serve_slot_pages=args.slot_pages,
+        serve_page_width=args.page_width,
+        encode_cache="on",
+        encode_cache_mb=args.encode_cache_mb,
+    )
+    state, _ = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+    cache = engine.encode_cache
+    server = CaptionServer(config, engine, port=0).start()
+    try:
+        port = server.port
+        base = _make_jpegs(16, config.image_size)
+        log(f"cache server up on port {port} (ring {cache.rows} rows, "
+            f"warm widths {cache.warm_widths})")
+        _post(port, base[0])  # warm pass (first-touch host costs)
+        compiles0 = tel.counters().get("jax/compiles", 0)
+
+        # --- parity: cold (miss) captions vs hit captions, bitwise ------
+        cache.flush()
+        cold, hot = [], []
+        for img in base:
+            status, body = _post_caption(port, img)
+            assert status == 200, f"cold caption -> {status}"
+            cold.append(body["captions"][0]["caption"])
+        s_after_cold = cache.stats()
+        for img in base:
+            status, body = _post_caption(port, img)
+            assert status == 200, f"hit caption -> {status}"
+            hot.append(body["captions"][0]["caption"])
+        s_after_hot = cache.stats()
+        mismatches = sum(1 for c, h in zip(cold, hot) if c != h)
+        hits_taken = s_after_hot["hits"] - s_after_cold["hits"]
+        log(f"parity: {len(base)} cold -> {len(base)} hot captions, "
+            f"{mismatches} mismatches ({hits_taken} served from cache)")
+        if mismatches or hits_taken < len(base):
+            log(f"FAIL: hit-path parity broken (mismatches={mismatches}, "
+                f"cache hits {hits_taken}/{len(base)})")
+            return 1
+
+        total = args.cache_requests
+
+        def arm(name, jpegs):
+            """Flush, run one open loop, return (loop, arm hit ratio,
+            arm stats deltas) — the ratio is computed over the arm's OWN
+            lookups so phases never cross-contaminate."""
+            cache.flush()
+            s0 = cache.stats()
+            loop = open_loop(port, jpegs, args.cache_rate, total)
+            s1 = cache.stats()
+            served = {
+                k: s1[k] - s0[k]
+                for k in ("hits", "misses", "coalesced", "evictions")
+            }
+            looked = (
+                served["hits"] + served["misses"] + served["coalesced"]
+            )
+            ratio = (
+                (served["hits"] + served["coalesced"]) / looked
+                if looked else 0.0
+            )
+            loop["goodput"] = (
+                loop["ok"] / loop["wall_s"] if loop["wall_s"] else 0.0
+            )
+            log(f"{name} arm @ {args.cache_rate}/s: {loop['ok']} ok, "
+                f"{loop['shed']} shed -> {loop['goodput']:.1f} req/s "
+                f"(p50 {loop['p50']}ms p99 {loop['p99']}ms); cache "
+                f"{served['hits']} hit / {served['misses']} miss / "
+                f"{served['coalesced']} coalesced -> ratio {ratio:.3f}")
+            return loop, round(ratio, 4), served
+
+        # unique control first: every arrival distinct
+        uniq_loop, uniq_ratio, _ = arm(
+            "unique", _make_jpegs(total, config.image_size)
+        )
+
+        # zipf arm: rank-weighted repeats over the small base
+        rng = np.random.default_rng(11)
+        p = 1.0 / (np.arange(len(base)) + 1.0) ** args.zipf_s
+        p = p / p.sum()
+        zipf_seq = [base[int(r)] for r in rng.choice(
+            len(base), size=total, p=p)]
+        zipf_loop, zipf_ratio, zipf_served = arm("zipf", zipf_seq)
+
+        recompiles = tel.counters().get("jax/compiles", 0) - compiles0
+        gather_ns = np.sort(np.asarray(
+            tel.durations_ns("serve/cache_gather"), np.float64))
+        gather_p95 = (
+            round(float(gather_ns[min(gather_ns.size - 1,
+                                      int(0.95 * gather_ns.size))]) / 1e6, 3)
+            if gather_ns.size else None
+        )
+        stats_block = _get_json(port, "/stats").get("encode_cache", {})
+        log(f"steady-state XLA compiles across all arms: {recompiles}; "
+            f"cache gather p95 {gather_p95}ms")
+
+        common = {
+            "requests_per_arm": total,
+            "offered_rate_per_s": args.cache_rate,
+            "encode_cache_mb": args.encode_cache_mb,
+            "cache_rows": cache.rows,
+            "zipf_s": args.zipf_s,
+            "zipf_base_images": len(base),
+            "steady_state_compiles": recompiles,
+            "parity_mismatches": mismatches,
+            **telemetry.bench_stamp(),
+        }
+        print(json.dumps({
+            "metric": "encode_cache_hit_ratio",
+            "value": zipf_ratio,
+            "unit": "ratio",
+            "unique_traffic_ratio": uniq_ratio,
+            "zipf_hits": zipf_served["hits"],
+            "zipf_misses": zipf_served["misses"],
+            "zipf_coalesced": zipf_served["coalesced"],
+            "zipf_evictions": zipf_served["evictions"],
+            "cache_entries": stats_block.get("entries"),
+            "cache_bytes": stats_block.get("bytes"),
+            "gather_p95_ms": gather_p95,
+            **common,
+        }), flush=True)
+        print(json.dumps({
+            "metric": "cache_serve_goodput_rps",
+            "value": round(zipf_loop["goodput"], 2),
+            "unit": "req_per_s",
+            "completed": zipf_loop["ok"], "shed": zipf_loop["shed"],
+            "p50_ms": zipf_loop["p50"], "p95_ms": zipf_loop["p95"],
+            "p99_ms": zipf_loop["p99"],
+            "unique_goodput_rps": round(uniq_loop["goodput"], 2),
+            "unique_p50_ms": uniq_loop["p50"],
+            "unique_p99_ms": uniq_loop["p99"],
+            **common,
+        }), flush=True)
+
+        ok = (
+            recompiles == 0
+            and mismatches == 0
+            and zipf_ratio >= 0.6
+            and uniq_ratio <= 0.05
+        )
+        if not ok:
+            log(f"FAIL: cache invariant violated (recompiles="
+                f"{recompiles}, parity mismatches {mismatches}, zipf "
+                f"ratio {zipf_ratio} < 0.6 or unique ratio {uniq_ratio} "
+                f"> 0.05)")
+        return 0 if ok else 1
+    finally:
+        _CLIENT.close_all()
+        server.shutdown()
+
+
 def _post_admin(port, action, timeout=240.0):
     """POST a lifecycle admin verb; (status, payload).  Long timeout:
     /promote blocks on the replica until the swap lands."""
@@ -1252,6 +1527,21 @@ def main() -> int:
                     help="metering mode: Zipf exponent for the repeat-"
                          "heavy arm (rank r drawn with p proportional "
                          "to 1/(r+1)^s over the 16 base images)")
+    ap.add_argument("--encode-cache", action="store_true",
+                    help="cache mode: content-addressed encode cache "
+                         "under Zipf vs unique traffic "
+                         "(encode_cache_hit_ratio / "
+                         "cache_serve_goodput_rps rows; exit 1 on any "
+                         "recompile, hit/cold caption mismatch, Zipf "
+                         "ratio < 0.6 or unique ratio > 0.05)")
+    ap.add_argument("--cache-rate", type=float, default=6.0,
+                    help="cache mode: open-loop Poisson rate per arm")
+    ap.add_argument("--cache-requests", type=int, default=80,
+                    help="cache mode: arrivals per arm")
+    ap.add_argument("--encode-cache-mb", type=int, default=8,
+                    help="cache mode: HBM ring budget (MB); the tiny "
+                         "bench grids need well under 1MB, so the "
+                         "default never evicts mid-arm")
     ap.add_argument("--lifecycle", action="store_true",
                     help="lifecycle mode: a full reload -> canary -> "
                          "promote cycle on a live continuous-mode server "
@@ -1270,7 +1560,8 @@ def main() -> int:
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="bench_serve_")
     made_workdir = args.workdir is None
-    if args.fleet or args.lifecycle or args.tenants or args.metering:
+    if (args.fleet or args.lifecycle or args.tenants or args.metering
+            or args.encode_cache):
         try:
             if args.fleet:
                 return fleet_bench(args, workdir)
@@ -1278,6 +1569,8 @@ def main() -> int:
                 return tenants_bench(args, workdir)
             if args.metering:
                 return metering_bench(args, workdir)
+            if args.encode_cache:
+                return encode_cache_bench(args, workdir)
             return lifecycle_bench(args, workdir)
         finally:
             if made_workdir:

@@ -836,6 +836,189 @@ def fleet_replica_kill(ctx: Ctx):
              "post_kill_ok", "retries", "routable_after")}
 
 
+# The encode-tier kill rehearsal: a disaggregated encode+decode fleet
+# behind the router.  SIGKILL the encode tier mid-traffic; the fleet
+# view must empty the tier within a poll, image traffic must shed
+# tier-scoped 429s (never 5xx), grids minted before the kill must keep
+# flowing to the decode tier throughout, and a respawn restores two-hop
+# service.
+_ENCODE_TIER_KILL_CHILD = r'''
+import json, os, sys, time, urllib.error, urllib.request
+
+import cv2
+import jax
+import numpy as np
+
+from sat_tpu import runtime, telemetry
+from sat_tpu.config import Config
+from sat_tpu.data.vocabulary import Vocabulary
+from sat_tpu.resilience import lineage
+from sat_tpu.serve.handoff import GRID_CONTENT_TYPE
+from sat_tpu.serve.replica import LocalFleet
+from sat_tpu.serve.router import Router
+from sat_tpu.train.checkpoint import save_checkpoint
+from sat_tpu.train.step import create_train_state
+
+workdir = sys.argv[1]
+vocab_file = os.path.join(workdir, "vocabulary.csv")
+vocabulary = Vocabulary(size=30)
+vocabulary.build(["a man riding a horse.", "a cat on a table."])
+vocabulary.save(vocab_file)
+config = Config(
+    phase="serve", image_size=32, dim_embedding=16, num_lstm_units=16,
+    dim_initialize_layer=16, dim_attend_layer=16, dim_decode_layer=32,
+    compute_dtype="float32", vocabulary_size=vocabulary.size,
+    vocabulary_file=vocab_file, beam_size=2,
+    serve_buckets=(1, 4), serve_max_batch=4,
+    save_dir=os.path.join(workdir, "models"),
+    summary_dir=os.path.join(workdir, "summary"),
+    heartbeat_interval=0.0,
+)
+os.makedirs(config.save_dir, exist_ok=True)
+tel = telemetry.enable()
+runtime._install_compile_listener()
+state = create_train_state(jax.random.PRNGKey(0), config)
+save_checkpoint(state, config)
+lineage.mark_last_good(config.save_dir, int(np.asarray(state.step)))
+
+fleet = LocalFleet(config, 2, root=os.path.join(workdir, "fleet"),
+                   tiers=["encode", "decode"])
+router = None
+try:
+    fleet.wait_ready(timeout_s=300.0)
+    router = Router(
+        config.replace(phase="route", route_poll_interval_s=0.2),
+        fleet.endpoints, fleet=fleet, port=0,
+    ).start()
+    port = router.port
+
+    img = np.random.default_rng(0).integers(
+        0, 255, (32, 32, 3), dtype=np.uint8)
+    ok, buf = cv2.imencode(".jpg", img)
+    jpeg = bytes(buf)
+
+    def post(data, ctype="image/jpeg", timeout=90.0):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/caption", data=data, method="POST",
+            headers={"Content-Type": ctype})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                r.read()
+                return r.status, dict(r.headers)
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code, dict(e.headers)
+        except (urllib.error.URLError, OSError):
+            return 0, {}
+
+    def healthz():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            return json.loads(r.read())
+
+    # a grid minted by the encode tier while it is alive: the starved
+    # phase replays it to prove the decode tier keeps serving
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{fleet.endpoints[0].port}/encode", data=jpeg,
+        method="POST", headers={"Content-Type": "image/jpeg"})
+    with urllib.request.urlopen(req, timeout=90.0) as r:
+        grid = r.read()
+        assert r.headers.get("Content-Type") == GRID_CONTENT_TYPE, (
+            r.headers.get("Content-Type"))
+
+    steady = [post(jpeg)[0] for _ in range(10)]
+    h0 = healthz()
+
+    fleet.replicas[0].kill()  # SIGKILL: the encode tier dies mid-fleet
+    deadline = time.time() + 20.0
+    while time.time() < deadline:
+        if healthz()["replicas_encode"] == 0:
+            break
+        time.sleep(0.1)
+
+    starved = [post(jpeg) for _ in range(6)]
+    grid_during = [post(grid, ctype=GRID_CONTENT_TYPE)[0]
+                   for _ in range(4)]
+
+    fleet.respawn("r0")  # same index -> same port, same encode tier
+    recovered = 0
+    deadline = time.time() + 300.0
+    while time.time() < deadline:
+        if healthz()["replicas_encode"] >= 1:
+            recovered = 1
+            break
+        time.sleep(0.5)
+    after = [post(jpeg)[0] for _ in range(6)]
+
+    statuses = (steady + [s for s, _h in starved] + grid_during + after)
+    print(json.dumps({
+        "steady": steady,
+        "handoffs": tel.counters().get("route/handoffs", 0),
+        "pre_kill_encode": h0.get("replicas_encode"),
+        "pre_kill_decode": h0.get("replicas_decode"),
+        "starved_statuses": sorted({s for s, _h in starved}),
+        "starved_tier_scoped": sum(
+            1 for s, h in starved
+            if s == 429 and h.get("X-Shed-Scope") == "tier"),
+        "starved_total": len(starved),
+        "grid_during": grid_during,
+        "recovered": recovered,
+        "after": after,
+        "bad_total": sum(1 for s in statuses if s == 0 or s >= 500),
+    }))
+finally:
+    if router is not None:
+        router.shutdown()
+    fleet.stop_all(timeout_s=30.0)
+'''
+
+
+@scenario
+def encode_tier_kill(ctx: Ctx):
+    """ISSUE 20 acceptance: SIGKILL the encode-tier replica of a
+    disaggregated encode+decode fleet mid-traffic.  The router's fleet
+    view empties the tier within a poll, image traffic sheds coherent
+    tier-scoped 429s (NEVER a 5xx), pre-minted grids keep flowing to
+    the decode tier the whole time, and a respawn restores two-hop
+    service."""
+    workdir = os.path.join(ctx.root, "encode_tier_kill")
+    os.makedirs(workdir, exist_ok=True)
+    proc = subprocess.run(
+        [sys.executable, "-c", _ENCODE_TIER_KILL_CHILD, workdir],
+        capture_output=True, text=True, cwd=REPO,
+        env=_child_env({}), timeout=_TIMEOUT,
+    )
+    check(proc.returncode == 0,
+          f"encode tier kill child rc {proc.returncode}\n"
+          f"{proc.stdout}\n{proc.stderr}")
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    check(all(s == 200 for s in result["steady"]),
+          f"two-hop steady traffic failed: {result['steady']}")
+    check(result["handoffs"] >= len(result["steady"]),
+          f"router never two-hopped: {result['handoffs']} handoffs")
+    check(result["pre_kill_encode"] == 1 and result["pre_kill_decode"] == 1,
+          f"fleet view missed a tier: {result['pre_kill_encode']} encode / "
+          f"{result['pre_kill_decode']} decode")
+    check(result["starved_statuses"] == [429],
+          f"starved image traffic saw {result['starved_statuses']}, "
+          "wanted only tier-scoped 429s")
+    check(result["starved_tier_scoped"] == result["starved_total"],
+          f"{result['starved_total'] - result['starved_tier_scoped']} "
+          "sheds lacked X-Shed-Scope: tier")
+    check(all(s == 200 for s in result["grid_during"]),
+          f"decode tier stopped serving grids during the outage: "
+          f"{result['grid_during']}")
+    check(result["recovered"] == 1,
+          "encode tier never rejoined the fleet view after respawn")
+    check(all(s == 200 for s in result["after"]),
+          f"two-hop service not restored after respawn: {result['after']}")
+    check(result["bad_total"] == 0,
+          f"{result['bad_total']} 5xx/conn-errors across the episode — "
+          "tier starvation must shed, not error")
+    return {k: result[k] for k in
+            ("handoffs", "starved_tier_scoped", "recovered", "bad_total")}
+
+
 # -- bulk offline captioning (ISSUE 14) -------------------------------------
 #
 # Both bulk scenarios decode the fixture's train images through the

@@ -127,6 +127,14 @@ DEFAULT_MARGINS = {
     # would-hit probe is a seeded-Zipf hit fraction, nearly deterministic
     "metering_overhead_pct": 25.0,
     "encode_cache_would_hit_ratio": 10.0,
+    # encode-cache rows (docs/SERVING.md "Encode cache & tiered
+    # fleets"): the ACTUAL hit ratio under seeded Zipf traffic is nearly
+    # deterministic (bench_serve exit-gates the 0.6 floor separately);
+    # the goodput row is an open loop on a shared CPU host — wide like
+    # the fleet family, as is the two-hop disaggregated arm
+    "encode_cache_hit_ratio": 10.0,
+    "cache_serve_goodput_rps": 10.0,
+    "fleet_disagg_goodput_rps": 10.0,
     # quality-plane row (docs/OBSERVABILITY.md "Caption quality"): the
     # same noise-floored microbench-over-p50 shape as metering_overhead
     # (bench_quality exit-gates the raw value at 0.5% separately)
@@ -163,10 +171,14 @@ _HIGHER_BETTER_EXACT = {
     "shard_feed_speedup",
     "min_speedup",
     "fleet_goodput_rps",
+    "fleet_disagg_goodput_rps",
     # a HIGHER would-be hit ratio means caching would pay off more —
     # the probe regressing toward 0 under the same seeded Zipf traffic
     # means the sketch (or its crc32c feed) broke
     "encode_cache_would_hit_ratio",
+    # ...and the ACTUAL ratio regressing under the same traffic means
+    # the device ring broke (keys drifting, over-eager flush/eviction)
+    "encode_cache_hit_ratio",
     "Bleu_4",
     "CIDEr",
     "METEOR",

@@ -25,7 +25,7 @@ GAVE_UP=""
 # RETRY_STAGES / RETRY_STAGE_CMD / RETRY_PROBE_CMD exist so the
 # give-up/artifact bookkeeping is testable without a device
 # (tests/test_bench.py); production runs never set them.
-ORDER=${RETRY_STAGES:-"bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 bench_ce_bf16 bench_eval_ab fused_decode bench_quant fleet_serve bench_bulk lifecycle_serve tenant_serve metering_serve quality_serve pallas pallas_serve profile bench_early_exit"}
+ORDER=${RETRY_STAGES:-"bench_rng_threefry bench_remat_decoder bench_remat_cnn_joint bench_resnet50 bench_B256 bench_ce_bf16 bench_eval_ab fused_decode bench_quant fleet_serve bench_bulk lifecycle_serve tenant_serve metering_serve quality_serve cache_serve pallas pallas_serve profile bench_early_exit"}
 
 stage_cmd() {
   if [ -n "${RETRY_STAGE_CMD:-}" ]; then echo "$RETRY_STAGE_CMD"; return; fi
@@ -45,8 +45,9 @@ stage_cmd() {
     # fused-decode K lanes on the real chip: bitwise parity vs stepped
     # K=1, on-device early exit, ladder AOT warmup with zero recompiles
     fused_decode)         echo "timeout 600 python -m pytest tests/test_continuous.py -q -k 'fused or multi_step or adaptive'" ;;
-    # replica subprocess boots + 3 open-loop arms through the router
-    fleet_serve)          echo "timeout 1200 python scripts/bench_serve.py --fleet" ;;
+    # replica subprocess boots + 3 open-loop arms through the router,
+    # then a second 2-replica encode/decode tiered fleet (disagg arm)
+    fleet_serve)          echo "timeout 1500 python scripts/bench_serve.py --fleet" ;;
     # three CLI child runs (seed checkpoint, decode, resume)
     bench_bulk)           echo "timeout 900 python scripts/bench_bulk.py" ;;
     # full reload -> canary -> promote cycle under open-loop load
@@ -60,6 +61,9 @@ stage_cmd() {
     # quality-on live arm + signal/sketch microbench: drift-plane
     # overhead gate (0.5% of serve p50), zero steady-state recompiles
     quality_serve)        echo "timeout 900 python scripts/bench_quality.py" ;;
+    # content-addressed encode cache: bitwise cold/hot parity, then
+    # unique vs Zipf open-loop arms (ratio floor 0.6, zero recompiles)
+    cache_serve)          echo "timeout 900 python scripts/bench_serve.py --encode-cache" ;;
     # batch sweep (4 sizes x up-to-4 loop compiles each) needs more than
     # the single-B budget
     pallas)               echo "timeout 1800 python scripts/bench_pallas.py" ;;

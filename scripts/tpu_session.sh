@@ -115,9 +115,10 @@ fi
 
 echo "=== stage 1g: fleet serve (router-fronted goodput scaling at 1/2/4 replicas) ==="
 # spawns max(fleet-sizes) replica subprocesses once, then open-loop load
-# through the jax-free router per fleet size; exits nonzero if any
-# replica recompiled in steady state (budget: replica boots + 3 arms)
-timeout 1200 python scripts/bench_serve.py --fleet \
+# through the jax-free router per fleet size, then boots a second 2-replica
+# encode/decode tiered fleet for the disaggregated arm; exits nonzero if
+# any replica recompiled in steady state (budget: replica boots + 4 arms)
+timeout 1500 python scripts/bench_serve.py --fleet \
   2>"$OUT/fleet_serve.log" | tee "$OUT/fleet_serve.json"
 rc=${PIPESTATUS[0]}
 if [ "$rc" -ne 0 ] || [ ! -s "$OUT/fleet_serve.json" ]; then
@@ -173,6 +174,19 @@ rc=${PIPESTATUS[0]}
 if [ "$rc" -ne 0 ] || [ ! -s "$OUT/metering_serve.json" ]; then
   echo "STAGE FAILED: metering_serve (rc=$rc) — see $OUT/metering_serve.log"
   FAILED="$FAILED metering_serve"
+fi
+
+echo "=== stage 1k2: encode cache (content-addressed HBM ring, Zipf vs unique) ==="
+# one continuous server with the device-resident encode cache armed:
+# cold/hot bitwise caption parity, then unique and Zipf open-loop arms;
+# exits nonzero on any parity mismatch, steady-state recompile, Zipf hit
+# ratio under 0.6, or a unique-arm ratio over 0.05 (false hits)
+timeout 900 python scripts/bench_serve.py --encode-cache \
+  2>"$OUT/cache_serve.log" | tee "$OUT/cache_serve.json"
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ] || [ ! -s "$OUT/cache_serve.json" ]; then
+  echo "STAGE FAILED: cache_serve (rc=$rc) — see $OUT/cache_serve.log"
+  FAILED="$FAILED cache_serve"
 fi
 
 echo "=== stage 1l: caption-quality plane (drift overhead gate) ==="

@@ -1,0 +1,640 @@
+"""Content-addressed encode cache + tier disaggregation (ISSUE 20;
+docs/SERVING.md "Encode cache & tiered fleets").
+
+Pins the contracts:
+
+* **hit-path bitwise parity** — a cache hit gathers the exact bits its
+  original encode produced, so the hit caption is byte-identical to the
+  cold caption (and the off-knob server agrees);
+* **LRU discipline** — evictions go oldest-first, hits refresh recency,
+  a plan never evicts a row it just pinned;
+* **single-flight coalescing** — N concurrent requests for one image
+  trigger exactly one encode, within a chunk (coalesced) or across
+  chunks (the plan-time map update);
+* **off-knob bit-identity** — ``--encode_cache off`` never constructs
+  the cache: same captions, no /stats cache block, zero compile delta;
+* **zero steady-state recompiles** with the cache on (gather/insert are
+  AOT-warmed per dispatch width like every other serve program);
+* **tier handoff** — /encode frames a grid a decode replica accepts on
+  /caption; corrupt bytes (crc), wrong aval, and cross-generation steps
+  are rejected before any device work;
+* **router tier units** — endpoint tier parsing, the merged view's
+  encode/decode routable sets, tier-restricted picks;
+* **lifecycle coherence** — promote/rollback flushes; keys carry the
+  param fingerprint so a stale entry could never hit anyway.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sat_tpu.serve import handoff
+from sat_tpu.serve.encode_cache import EncodeCache
+from sat_tpu.serve.replica import Endpoint, LocalFleet, parse_endpoints
+from sat_tpu.serve.router import merge_fleet, tier_capable
+
+# ---------------------------------------------------------------------------
+# EncodeCache planning: hit/miss/coalesce, LRU order, flush/drop (CPU jax)
+# ---------------------------------------------------------------------------
+
+ROW_SHAPE = (4, 8)
+
+
+def _cache(min_rows=3, widths=(1, 2, 4), tel=None):
+    """A tiny ring: capacity_mb=0 floors rows at min_rows+1, so the LRU
+    edge cases are reachable with a handful of keys."""
+    c = EncodeCache(0, tel=tel)
+    c.ensure_store(ROW_SHAPE, np.float32, min_rows=min_rows)
+    c.warm(widths)
+    return c
+
+
+def test_plan_hit_miss_and_counters():
+    c = _cache()
+    assert c.rows == 4 and c.warm_widths == (1, 2, 4)
+    p1 = c.plan(["a", "b"])
+    assert p1.n_miss == 2 and p1.hits == 0 and p1.coalesced == 0
+    assert p1.miss_keys == ["a", "b"] and p1.miss_pos == [0, 1]
+    assert p1.rows == p1.miss_rows
+    p2 = c.plan(["b", "a"])
+    assert p2.n_miss == 0 and p2.hits == 2
+    assert p2.rows == [p1.miss_rows[1], p1.miss_rows[0]]
+    assert c.hits == 2 and c.misses == 2 and c.lookups == 4
+    assert c.hit_ratio() == pytest.approx(0.5)
+    stats = c.stats()
+    assert stats["entries"] == 2
+    assert stats["bytes"] == 2 * c.row_bytes
+    assert stats["capacity_bytes"] == 4 * c.row_bytes
+
+
+def test_plan_coalesces_repeats_within_chunk():
+    c = _cache()
+    p = c.plan(["x", "x", "x", "y"])
+    assert p.n_miss == 2 and p.coalesced == 2 and p.hits == 0
+    # repeats ride the first occurrence's row: one encode, three seeds
+    assert p.rows[0] == p.rows[1] == p.rows[2] != p.rows[3]
+    assert p.miss_pos == [0, 3]
+    # coalesced requests skipped the encode lane: they count as hits
+    assert c.hit_ratio() == pytest.approx(0.5)
+
+
+def test_lru_eviction_oldest_first_and_hit_refreshes():
+    c = _cache(min_rows=3)  # 4 rows
+    c.plan(["k1", "k2", "k3", "k4"])  # fills the ring
+    c.plan(["k5"])  # evicts k1 (oldest)
+    assert c.evictions == 1
+    assert c.plan(["k4"]).hits == 1   # still resident
+    assert c.plan(["k1"]).n_miss == 1  # evicted k2 to readmit k1
+    # a hit refreshes recency: k3 would be next out, but touching it
+    # pushes the eviction onto k5
+    c.plan(["k3"])
+    c.plan(["k6"])
+    assert c.plan(["k3"]).hits == 1
+    assert c.plan(["k5"]).n_miss == 1
+
+
+def test_plan_never_evicts_a_row_it_just_pinned():
+    c = _cache(min_rows=3)  # 4 rows: a full-width miss chunk pins all 4
+    c.plan(["a", "b", "c", "d"])
+    p = c.plan(["e", "f", "g", "h"])  # every alloc must evict, none pinned
+    assert p.n_miss == 4
+    assert len(set(p.rows)) == 4  # four distinct rows, no clobbering
+    assert c.evictions == 4
+
+
+def test_drop_unplans_failed_misses():
+    c = _cache()
+    p = c.plan(["a", "b"])
+    c.drop(p.miss_keys)  # dispatch failed: rows hold garbage
+    p2 = c.plan(["a", "b"])  # must re-encode, not serve garbage hits
+    assert p2.n_miss == 2
+
+
+def test_flush_forgets_everything():
+    c = _cache()
+    c.plan(["a", "b", "c"])
+    c.flush()
+    assert c.stats()["entries"] == 0 and c.flushes == 1
+    assert c.plan(["a"]).n_miss == 1
+
+
+def test_insert_gather_roundtrip_bitwise_and_scratch_isolation():
+    c = _cache()
+    rng = np.random.default_rng(3)
+    p = c.plan(["a", "b"])
+    lane = rng.standard_normal((2,) + ROW_SHAPE).astype(np.float32)
+    c.insert(2, lane, p.miss_rows)
+    # gather at a WIDER width: pad positions read the scratch row and
+    # real rows come back bitwise
+    out = np.asarray(c.gather(4, p.rows))
+    assert np.array_equal(out[0], lane[0])
+    assert np.array_equal(out[1], lane[1])
+    # insert padded to a wider lane: pad rows land in scratch, the ring
+    # rows of 'a'/'b' are untouched
+    lane4 = rng.standard_normal((4,) + ROW_SHAPE).astype(np.float32)
+    p2 = c.plan(["c"])
+    c.insert(4, lane4, p2.miss_rows)
+    again = np.asarray(c.gather(2, p.rows))
+    assert np.array_equal(again[0], lane[0])
+    assert np.array_equal(again[1], lane[1])
+    assert np.array_equal(np.asarray(c.gather(1, p2.rows))[0], lane4[0])
+
+
+def test_ensure_store_idempotent_and_aval_mismatch_raises():
+    c = _cache()
+    c.ensure_store(ROW_SHAPE, np.float32, min_rows=3)  # re-warm: no-op
+    assert c.rows == 4
+    with pytest.raises(ValueError, match="warmup now wants"):
+        c.ensure_store((5, 8), np.float32, min_rows=3)
+    with pytest.raises(ValueError, match="warmup now wants"):
+        c.ensure_store(ROW_SHAPE, np.float16, min_rows=3)
+
+
+def test_capacity_mb_sizes_the_ring():
+    c = EncodeCache(1)  # 1 MB over 128-byte rows
+    c.ensure_store(ROW_SHAPE, np.float32, min_rows=3)
+    assert c.rows == int(1e6) // (4 * 8 * 4)
+
+
+# ---------------------------------------------------------------------------
+# Handoff frame: roundtrip + rejection (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def test_handoff_roundtrip_bitwise():
+    grid = np.arange(24, dtype=np.float32).reshape(4, 6)
+    frame = handoff.encode_grid(grid, step=17)
+    out, header = handoff.decode_grid(frame)
+    assert np.array_equal(out, grid) and out.dtype == grid.dtype
+    assert header["step"] == 17 and header["shape"] == [4, 6]
+
+
+def test_handoff_crc_rejects_flipped_bit():
+    frame = bytearray(handoff.encode_grid(np.ones((2, 3), np.float32)))
+    frame[-1] ^= 0x40  # flip one payload bit
+    with pytest.raises(handoff.HandoffError, match="crc32c mismatch"):
+        handoff.decode_grid(bytes(frame))
+
+
+def test_handoff_rejects_malformed_frames():
+    good = handoff.encode_grid(np.ones((2, 3), np.float32))
+    with pytest.raises(handoff.HandoffError, match="payload is"):
+        handoff.decode_grid(good[:-4])  # truncated
+    with pytest.raises(handoff.HandoffError, match="no header line"):
+        handoff.decode_grid(b"\xff" * 64)
+    with pytest.raises(handoff.HandoffError, match="bad magic"):
+        handoff.decode_grid(b'{"magic": "nope"}\n')
+    with pytest.raises(handoff.HandoffError, match="bad header field"):
+        handoff.decode_grid(b'{"magic": "sat-grid1", "dtype": "float32"}\n')
+    with pytest.raises(handoff.HandoffError, match="non-positive"):
+        handoff.decode_grid(
+            b'{"magic": "sat-grid1", "dtype": "float32", '
+            b'"shape": [0, 3], "crc32c": 1}\n'
+        )
+
+
+def test_handoff_check_aval():
+    grid = np.ones((4, 6), np.float32)
+    handoff.check_aval(grid, (4, 6), np.float32)  # matching: no raise
+    with pytest.raises(handoff.HandoffError, match="aval mismatch"):
+        handoff.check_aval(grid, (4, 7), np.float32)
+    with pytest.raises(handoff.HandoffError, match="aval mismatch"):
+        handoff.check_aval(grid, (4, 6), np.float16)
+
+
+# ---------------------------------------------------------------------------
+# Router tier units (pure; jax-free)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_endpoints_with_tiers():
+    eps = parse_endpoints("h1:8000,h2:8001=encode,h3:8002=decode")
+    assert [e.tier for e in eps] == ["both", "encode", "decode"]
+    assert eps[1].address == "h2:8001"
+    assert "=encode" in repr(eps[1]) and "=" not in repr(eps[0]).split("h1")[1]
+    with pytest.raises(ValueError, match="tier must be"):
+        parse_endpoints("h1:8000=gpu")
+    with pytest.raises(ValueError, match="host:port"):
+        parse_endpoints("8000=encode")
+
+
+def test_tier_capable_matrix():
+    assert tier_capable("both", "encode") and tier_capable("both", "decode")
+    assert tier_capable("encode", "encode")
+    assert not tier_capable("encode", "decode")
+    assert tier_capable("decode", "decode")
+    assert not tier_capable("decode", "encode")
+    # unknown tier (pre-tier replica): treated as both
+    assert tier_capable(None, "encode") and tier_capable(None, "decode")
+
+
+def _snap(tier=None, ready=True, **kw):
+    base = {
+        "reachable": ready,
+        "ready": ready,
+        "status": "ok" if ready else "unreachable",
+        "degraded": False,
+        "tier": tier,
+        "queue_depth": 0,
+        "in_flight": 0,
+        "serve_mode": "batch",
+        "p50_ms": 5.0,
+        "p99_ms": 9.0,
+        "failures": 0,
+    }
+    base.update(kw)
+    return base
+
+
+def test_merge_fleet_tier_sets():
+    view = merge_fleet(
+        {
+            "r0": _snap("encode"),
+            "r1": _snap("decode"),
+            "r2": _snap("both"),
+            "r3": _snap(None),          # pre-tier replica: both
+            "r4": _snap("decode", ready=False),  # down: in neither set
+        },
+        {},
+        straggler_factor=2.0,
+        down_weight=0.25,
+    )
+    assert view["routable"] == ["r0", "r1", "r2", "r3"]
+    assert view["routable_encode"] == ["r0", "r2", "r3"]
+    assert view["routable_decode"] == ["r1", "r2", "r3"]
+    # a drained encode replica leaves the encode set too
+    view2 = merge_fleet(
+        {"r0": _snap("encode"), "r1": _snap("decode")},
+        {"r0": "draining"},
+        straggler_factor=2.0,
+        down_weight=0.25,
+    )
+    assert view2["routable_encode"] == []
+    assert view2["routable_decode"] == ["r1"]
+
+
+def test_local_fleet_tier_validation(tmp_path):
+    from sat_tpu.config import Config
+
+    with pytest.raises(ValueError, match="names 3 replicas"):
+        LocalFleet(
+            Config(), 2, root=str(tmp_path),
+            tiers=["encode", "decode", "both"],
+        )
+    with pytest.raises(ValueError, match="must be one of"):
+        LocalFleet(Config(), 1, root=str(tmp_path), tiers=["gpu"])
+
+
+def test_endpoint_tier_defaults_both():
+    e = Endpoint("r0", "h", 1)
+    assert e.tier == "both"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end on a booted CPU server (tiny model, batch mode)
+# ---------------------------------------------------------------------------
+
+TINY_MODEL = dict(
+    phase="serve",
+    image_size=32,
+    dim_embedding=16,
+    num_lstm_units=16,
+    dim_initialize_layer=16,
+    dim_attend_layer=16,
+    dim_decode_layer=32,
+    compute_dtype="float32",
+    beam_size=2,
+    serve_buckets=(1, 4),
+    serve_max_batch=4,
+    serve_max_wait_ms=25.0,
+    serve_queue_depth=16,
+    heartbeat_interval=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def cachestack(tmp_path_factory):
+    import os
+
+    import cv2
+    import jax
+
+    from sat_tpu import runtime, telemetry
+    from sat_tpu.config import Config
+    from sat_tpu.data.vocabulary import Vocabulary
+    from sat_tpu.resilience import lineage
+    from sat_tpu.serve.engine import ServeEngine, load_serving_state
+    from sat_tpu.train.checkpoint import save_checkpoint
+    from sat_tpu.train.step import create_train_state
+
+    root = str(tmp_path_factory.mktemp("encode_cache"))
+    vocab_file = os.path.join(root, "vocabulary.csv")
+    vocabulary = Vocabulary(size=30)
+    vocabulary.build(["a man riding a horse.", "a cat on a table."])
+    vocabulary.save(vocab_file)
+    config = Config(
+        **TINY_MODEL,
+        vocabulary_size=vocabulary.size,
+        vocabulary_file=vocab_file,
+        save_dir=os.path.join(root, "models"),
+        summary_dir=os.path.join(root, "summary"),
+        encode_cache="on",
+        encode_cache_mb=4,
+    )
+    os.makedirs(config.save_dir, exist_ok=True)
+    tel = telemetry.enable(capacity=16384)
+    runtime._install_compile_listener()
+    state = create_train_state(jax.random.PRNGKey(0), config)
+    save_checkpoint(state, config)
+    lineage.mark_last_good(config.save_dir, int(np.asarray(state.step)))
+    state, _source = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+    # off-knob twin on the same checkpoint: the bit-identity oracle
+    off_config = config.replace(encode_cache="off")
+    off_state, _ = load_serving_state(off_config)
+    off_engine = ServeEngine(off_config, off_state, vocabulary, tel=tel)
+    off_engine.warmup()
+
+    rng = np.random.default_rng(0)
+    jpegs = []
+    for _ in range(8):
+        img = rng.integers(0, 255, (32, 32, 3), dtype=np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        jpegs.append(bytes(buf))
+    yield {
+        "config": config,
+        "engine": engine,
+        "off_config": off_config,
+        "off_engine": off_engine,
+        "tel": tel,
+        "jpegs": jpegs,
+    }
+    telemetry.disable()
+
+
+def _boot(cachestack, on=True, **overrides):
+    from sat_tpu.serve.server import CaptionServer
+
+    which = "config" if on else "off_config"
+    eng = "engine" if on else "off_engine"
+    config = cachestack[which].replace(**overrides)
+    return CaptionServer(config, cachestack[eng], port=0).start()
+
+
+def _post(port, data, ctype="image/jpeg", headers=None, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/caption",
+        data=data,
+        method="POST",
+        headers={"Content-Type": ctype, **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post_encode(port, data, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/encode",
+        data=data,
+        method="POST",
+        headers={"Content-Type": "image/jpeg"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), r.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("Content-Type")
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return r.status, r.read().decode()
+
+
+def test_e2e_hit_bitwise_parity_stats_and_zero_recompiles(cachestack):
+    """The acceptance pin: a repeat request is served from the ring with
+    a caption byte-identical to its cold encode, the /stats cache block
+    reflects it, and the whole exchange compiles nothing."""
+    tel = cachestack["tel"]
+    engine = cachestack["engine"]
+    server = _boot(cachestack)
+    try:
+        jpeg = cachestack["jpegs"][0]
+        status, cold = _post(server.port, jpeg)  # miss: encodes + inserts
+        assert status == 200
+        compiles0 = tel.counters().get("jax/compiles", 0)
+        h0 = engine.encode_cache.hits
+        status, warm = _post(server.port, jpeg)  # hit: gather only
+        assert status == 200
+        assert engine.encode_cache.hits > h0
+        # bitwise caption parity: words AND scores identical
+        assert warm["captions"] == cold["captions"]
+        status, raw = _get(server.port, "/stats")
+        stats = json.loads(raw)
+        block = stats["encode_cache"]
+        assert block["entries"] >= 1 and block["hits"] >= 1
+        assert block["bytes"] <= block["capacity_bytes"]
+        assert block["warm_widths"] == [1, 4]
+        assert 0.0 < block["hit_ratio"] <= 1.0
+        assert stats["tier"] == "both"
+        # zero steady-state recompiles through miss AND hit paths
+        assert tel.counters().get("jax/compiles", 0) == compiles0
+        status, health = _get(server.port, "/healthz")
+        assert json.loads(health)["tier"] == "both"
+        # /metrics: cache residency gauges + counters exported
+        _s, text = _get(server.port, "/metrics")
+        assert 'sat_gauge{name="serve/cache_entries"}' in text
+        assert 'sat_counter_total{name="serve/cache_hits"}' in text
+    finally:
+        server.shutdown()
+
+
+def test_e2e_off_knob_bit_identity_zero_compile_delta(cachestack):
+    """--encode_cache off serves the byte-identical caption the cached
+    server produced, with no cache block and zero compile delta."""
+    tel = cachestack["tel"]
+    jpeg = cachestack["jpegs"][0]
+    on_server = _boot(cachestack)
+    try:
+        _status, on_payload = _post(on_server.port, jpeg)
+    finally:
+        on_server.shutdown()
+    off_server = _boot(cachestack, on=False)
+    try:
+        assert cachestack["off_engine"].encode_cache is None
+        compiles0 = tel.counters().get("jax/compiles", 0)
+        status, off_payload = _post(off_server.port, jpeg)
+        assert status == 200
+        assert off_payload["captions"] == on_payload["captions"]
+        assert tel.counters().get("jax/compiles", 0) == compiles0
+        stats = json.loads(_get(off_server.port, "/stats")[1])
+        assert "encode_cache" not in stats
+    finally:
+        off_server.shutdown()
+
+
+def test_e2e_single_flight_coalescing_burst(cachestack):
+    """A concurrent burst of one NEW image triggers exactly one encode:
+    the first plan registers the key, everyone else coalesces or hits."""
+    engine = cachestack["engine"]
+    server = _boot(cachestack)
+    try:
+        jpeg = cachestack["jpegs"][1]
+        m0 = engine.encode_cache.misses
+        s0 = engine.encode_cache.hits + engine.encode_cache.coalesced
+        n = 4
+        barrier = threading.Barrier(n)
+        results = [None] * n
+
+        def client(i):
+            barrier.wait()
+            results[i] = _post(server.port, jpeg)
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert all(r is not None and r[0] == 200 for r in results)
+        captions = [r[1]["captions"] for r in results]
+        assert all(c == captions[0] for c in captions)
+        # exactly ONE miss for the new key; the other three rode it
+        assert engine.encode_cache.misses == m0 + 1
+        assert engine.encode_cache.hits + engine.encode_cache.coalesced >= (
+            s0 + n - 1
+        )
+    finally:
+        server.shutdown()
+
+
+def test_e2e_encode_endpoint_and_grid_caption_parity(cachestack):
+    """The tier handoff end-to-end on one replica: /encode mints a
+    framed grid, /caption accepts it (grid content type) and answers
+    with the byte-identical caption the image path produces."""
+    server = _boot(cachestack)
+    try:
+        jpeg = cachestack["jpegs"][2]
+        status, image_payload = _post(server.port, jpeg)
+        assert status == 200
+        status, frame, ctype = _post_encode(server.port, jpeg)
+        assert status == 200 and ctype == handoff.GRID_CONTENT_TYPE
+        grid, header = handoff.decode_grid(frame)
+        engine = cachestack["engine"]
+        assert tuple(grid.shape) == engine.ctx_row_shape
+        assert header["step"] == engine.step
+        status, grid_payload = _post(
+            server.port, frame, ctype=handoff.GRID_CONTENT_TYPE
+        )
+        assert status == 200
+        assert grid_payload["captions"] == image_payload["captions"]
+        stats = json.loads(_get(server.port, "/stats")[1])
+        assert stats["counters"].get("serve/grid_requests", 0) >= 1
+    finally:
+        server.shutdown()
+
+
+def test_e2e_grid_rejections_crc_aval_stale(cachestack):
+    """Corrupt frames never reach the device: flipped payload bit → 400
+    (crc), wrong aval → 400, cross-generation step → 409."""
+    engine = cachestack["engine"]
+    server = _boot(cachestack)
+    try:
+        jpeg = cachestack["jpegs"][3]
+        status, frame, _ctype = _post_encode(server.port, jpeg)
+        assert status == 200
+        corrupt = bytearray(frame)
+        corrupt[-1] ^= 0x01
+        status, payload = _post(
+            server.port, bytes(corrupt), ctype=handoff.GRID_CONTENT_TYPE
+        )
+        assert status == 400 and payload["error"] == "bad grid"
+        assert "crc32c" in payload["detail"]
+        bad_aval = handoff.encode_grid(
+            np.zeros((3, 5), np.float32), step=engine.step
+        )
+        status, payload = _post(
+            server.port, bad_aval, ctype=handoff.GRID_CONTENT_TYPE
+        )
+        assert status == 400 and "aval mismatch" in payload["detail"]
+        grid, _header = handoff.decode_grid(frame)
+        stale = handoff.encode_grid(np.asarray(grid), step=engine.step + 7)
+        status, payload = _post(
+            server.port, stale, ctype=handoff.GRID_CONTENT_TYPE
+        )
+        assert status == 409
+    finally:
+        server.shutdown()
+
+
+def test_promote_flushes_cache_and_fingerprint_keys(cachestack):
+    """Lifecycle coherence: promoting a candidate flushes the ring, and
+    the param fingerprint in every key changes with the serving step, so
+    a pre-promote entry could never have served a post-promote hit."""
+    engine = cachestack["engine"]
+    server = _boot(cachestack)
+    try:
+        jpeg = cachestack["jpegs"][4]
+        status, before = _post(server.port, jpeg)
+        assert status == 200
+        assert engine.encode_cache.stats()["entries"] >= 1
+        fp0 = engine.param_fingerprint()
+        old_step = engine.step
+        flushes0 = engine.encode_cache.flushes
+        # stage the incumbent's own trees as a "new" candidate and flip
+        engine.install_candidate(
+            engine._variables, engine._decoder_params,
+            step=old_step + 1, source="test",
+        )
+        try:
+            assert engine.promote_candidate() == old_step + 1
+            assert engine.encode_cache.flushes == flushes0 + 1
+            assert engine.encode_cache.stats()["entries"] == 0
+            assert engine.param_fingerprint() != fp0
+            # re-request: a fresh miss under the new generation, same
+            # caption (identical params)
+            m0 = engine.encode_cache.misses
+            status, after = _post(server.port, jpeg)
+            assert status == 200
+            assert engine.encode_cache.misses == m0 + 1
+            assert after["captions"] == before["captions"]
+        finally:
+            # restore the original generation for later tests
+            engine.step = old_step
+            engine.encode_cache.flush()
+    finally:
+        server.shutdown()
+
+
+def test_e2e_encode_tier_server_warms_before_ready(cachestack):
+    """A serve_tier=encode replica warms its width-1 executable before
+    ready: the first /encode request compiles nothing, and its tier
+    shows on /healthz for the router's poller."""
+    tel = cachestack["tel"]
+    server = _boot(cachestack, serve_tier="encode")
+    try:
+        status, health = _get(server.port, "/healthz")
+        assert json.loads(health)["tier"] == "encode"
+        compiles0 = tel.counters().get("jax/compiles", 0)
+        status, frame, ctype = _post_encode(
+            server.port, cachestack["jpegs"][5]
+        )
+        assert status == 200 and ctype == handoff.GRID_CONTENT_TYPE
+        handoff.decode_grid(frame)  # verifies the frame end-to-end
+        assert tel.counters().get("jax/compiles", 0) == compiles0
+        assert json.loads(_get(server.port, "/stats")[1])["tier"] == "encode"
+    finally:
+        server.shutdown()

@@ -567,3 +567,81 @@ def test_e2e_metering_off_knob(stack):
         assert "tenants_cost" not in stats and "capacity" not in stats
     finally:
         server.shutdown()
+
+
+def test_e2e_encode_cache_hits_bill_zero_encode_and_identity(stack):
+    """ISSUE 20 metering satellite: with the content-addressed encode
+    cache on, a cache-hit request is charged ZERO encode device-ms (only
+    the miss requests split the measured encode window), and the
+    attributed≈measured accounting identity still holds within ±5% under
+    Zipf-style repeats.  Tenants split the traffic so the assertion is
+    exact: 'cold' sends each unique image first (all misses), 'warm'
+    sends only repeats (all hits)."""
+    import time
+
+    from sat_tpu.data.vocabulary import Vocabulary
+    from sat_tpu.serve.engine import ServeEngine, load_serving_state
+    from sat_tpu.serve.server import CaptionServer
+
+    tel, jpegs = stack["tel"], stack["jpegs"]
+    config = stack["config"].replace(
+        encode_cache="on",
+        encode_cache_mb=4,
+        tenants="cold:1,warm:1",
+    )
+    vocabulary = Vocabulary(config.vocabulary_size, config.vocabulary_file)
+    state, _source = load_serving_state(config)
+    engine = ServeEngine(config, state, vocabulary, tel=tel)
+    engine.warmup()
+    server = CaptionServer(config, engine, port=0).start()
+    try:
+        assert engine.encode_cache is not None
+        compiles0 = tel.counters().get("jax/compiles", 0)
+        busy0 = measured_busy_ms(tel)
+        attr0 = server.metering.attributed_device_ms()
+        # cold tenant encodes each unique image once...
+        for jpeg in jpegs:
+            status, _payload = _post(
+                server.port, jpeg, headers={"X-Tenant": "cold"}
+            )
+            assert status == 200
+        # ...then the Zipf head repeats land as pure hits on 'warm'
+        rng = np.random.default_rng(11)
+        ranks = np.arange(1, len(jpegs) + 1, dtype=np.float64)
+        p = (1.0 / ranks ** 1.1) / (1.0 / ranks ** 1.1).sum()
+        for pick in rng.choice(len(jpegs), size=10, p=p):
+            status, _payload = _post(
+                server.port, jpegs[int(pick)], headers={"X-Tenant": "warm"}
+            )
+            assert status == 200
+        # hit requests billed zero encode device-ms; misses paid it all
+        snap = server.metering.snapshot()
+        assert snap["warm"]["requests"] == 10
+        assert snap["warm"]["encode_ms"] == 0.0
+        assert snap["warm"]["decode_ms"] > 0  # hits still decode
+        assert snap["cold"]["encode_ms"] > 0
+        # the identity: attributed ≈ measured busy within ±5% — the
+        # cache gather rides its own span OUTSIDE the busy set, so hits
+        # don't dilute the ledger
+        attributed = server.metering.attributed_device_ms() - attr0
+        measured = measured_busy_ms(tel) - busy0
+        assert measured > 0
+        assert abs(attributed - measured) <= 0.05 * measured
+        # zero steady-state compiles with cache + metering both on
+        assert tel.counters().get("jax/compiles", 0) == compiles0
+        # the ACTUAL hit ratio publishes next to the sketch's would-hit
+        # prediction, plus the reconciliation delta
+        assert engine.encode_cache.hit_ratio() > 0.5
+        time.sleep(1.1)  # capacity tick interval
+        _s, text = _get(server.port, "/metrics")
+        assert 'sat_gauge{name="capacity/encode_cache_hit_ratio"}' in text
+        assert 'sat_gauge{name="capacity/encode_cache_would_hit_ratio"}' in text
+        assert 'sat_gauge{name="capacity/encode_cache_reconcile_delta"}' in text
+        gauges = tel.gauges()
+        delta = gauges["capacity/encode_cache_reconcile_delta"]
+        assert abs(delta) <= 1.0  # a bounded ratio-vs-ratio difference
+        assert gauges["capacity/encode_cache_hit_ratio"] == pytest.approx(
+            engine.encode_cache.hit_ratio(), abs=1e-3
+        )
+    finally:
+        server.shutdown()

@@ -213,6 +213,8 @@ class StubReplica:
         self.caption_status = 200
         self.retry_after = "7"  # the per-replica hint the router ignores
         self.seen_rids = []
+        self.seen_paths = []
+        self.seen_ctypes = []
         stub = self
 
         class _H(BaseHTTPRequestHandler):
@@ -245,6 +247,8 @@ class StubReplica:
                 self.rfile.read(length)
                 rid = self.headers.get(tracectx.TRACE_HEADER)
                 stub.seen_rids.append(rid)
+                stub.seen_paths.append(self.path)
+                stub.seen_ctypes.append(self.headers.get("Content-Type"))
                 status = stub.caption_status
                 if status == 429:
                     self._reply(
@@ -452,6 +456,68 @@ def test_proactive_shed_at_configured_depth(stub_pair, tmp_path):
     status, _, _, _ = router.proxy_caption(b"img", "rid-depth-2")
     assert status == 200
     router.shutdown()
+
+
+def test_tiered_fleet_two_hops_passthrough_and_starved_shed(tmp_path):
+    """Disaggregated routing against scripted stubs: an image request
+    makes two hops (/encode on the encode tier, then the grid body to
+    /caption on the decode tier); a client-supplied grid skips hop one;
+    a starved tier sheds 429 (scope=tier), never a 5xx."""
+    from sat_tpu.serve.handoff import GRID_CONTENT_TYPE
+
+    enc, dec = StubReplica("r0"), StubReplica("r1")
+    enc.health["tier"] = "encode"
+    dec.health["tier"] = "decode"
+    router = Router(
+        _router_config(tmp_path), [enc.endpoint, dec.endpoint]
+    )
+    try:
+        router.poll_once()
+        view = router.view()
+        assert view["routable_encode"] == ["r0"]
+        assert view["routable_decode"] == ["r1"]
+        # image in: encode hop mints the grid, decode hop captions it
+        status, _body, _ct, headers = router.proxy_caption(
+            b"img", "rid-tier-1", content_type="image/jpeg"
+        )
+        assert status == 200
+        assert enc.seen_paths == ["/encode"]
+        assert dec.seen_paths == ["/caption"]
+        assert dec.seen_ctypes == [GRID_CONTENT_TYPE]
+        assert headers.get("X-Routed-Encode-Replica") == "r0"
+        assert headers.get("X-Routed-Replica") == "r1"
+        # rid propagates across BOTH hops (trace stitching)
+        assert enc.seen_rids == ["rid-tier-1"]
+        assert dec.seen_rids == ["rid-tier-1"]
+        # a client-supplied grid goes straight to the decode tier
+        status, _b, _c, _h = router.proxy_caption(
+            b"frame", "rid-tier-2", content_type=GRID_CONTENT_TYPE
+        )
+        assert status == 200
+        assert enc.seen_paths == ["/encode"]  # untouched
+        assert dec.seen_paths == ["/caption", "/caption"]
+        # encode tier gone: image traffic sheds coherently (429, scope
+        # tier — capacity returns on respawn), grids still flow
+        enc.health["ready"] = False
+        router.poll_once()
+        status, _b, _c, headers = router.proxy_caption(
+            b"img", "rid-tier-3", content_type="image/jpeg"
+        )
+        assert status == 429
+        assert headers["X-Shed-Scope"] == "tier"
+        status, _b, _c, _h = router.proxy_caption(
+            b"frame", "rid-tier-4", content_type=GRID_CONTENT_TYPE
+        )
+        assert status == 200
+        # healthz/stats carry the tier split for operators
+        payload, _code = router.healthz()
+        assert payload["replicas_encode"] == 0
+        assert payload["replicas_decode"] == 1
+        assert router.stats()["routable_decode"] == ["r1"]
+    finally:
+        router.shutdown()
+        enc.stop()
+        dec.stop()
 
 
 # ---------------------------------------------------------------------------
